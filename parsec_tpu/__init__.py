@@ -1,0 +1,48 @@
+"""parsec_tpu — a TPU-native distributed task-based runtime.
+
+A ground-up re-design of the capabilities of PaRSEC (ICL/UTK's Parallel
+Runtime Scheduling and Execution Controller; reference tree surveyed in
+``SURVEY.md``): DAGs of micro-tasks with data-dependency edges, expressed
+via a Parameterized Task Graph (PTG) builder or Dynamic Task Discovery
+(DTD), executed by a work-stealing multi-threaded scheduler with distributed
+dependency resolution — with task bodies compiled to XLA computations and
+accelerator residency managed over TPU HBM, inter-chip traffic riding
+ICI/DCN via JAX collectives instead of MPI.
+"""
+
+from .version import __version__
+from .utils import debug, mca_param
+from .core import (
+    AccessMode,
+    Chore,
+    CompoundTaskpool,
+    Context,
+    Flow,
+    HookReturn,
+    Task,
+    TaskClass,
+    Taskpool,
+    TaskStatus,
+    compose,
+    DEV_CPU,
+    DEV_TPU,
+)
+
+__all__ = [
+    "__version__",
+    "debug",
+    "mca_param",
+    "AccessMode",
+    "Chore",
+    "CompoundTaskpool",
+    "Context",
+    "Flow",
+    "HookReturn",
+    "Task",
+    "TaskClass",
+    "Taskpool",
+    "TaskStatus",
+    "compose",
+    "DEV_CPU",
+    "DEV_TPU",
+]

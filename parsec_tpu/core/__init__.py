@@ -1,0 +1,25 @@
+"""Core runtime (reference L2): context, taskpools, tasks, scheduling."""
+
+from .lifecycle import AccessMode, HookReturn, TaskStatus, DEV_CPU, DEV_TPU
+from .task import Chore, Flow, Task, TaskClass
+from .taskpool import Taskpool
+from .context import Context, ExecutionStream
+from .compound import CompoundTaskpool, compose
+from . import sched  # register scheduler components
+
+__all__ = [
+    "AccessMode",
+    "HookReturn",
+    "TaskStatus",
+    "DEV_CPU",
+    "DEV_TPU",
+    "Chore",
+    "Flow",
+    "Task",
+    "TaskClass",
+    "Taskpool",
+    "Context",
+    "ExecutionStream",
+    "CompoundTaskpool",
+    "compose",
+]

@@ -1,0 +1,273 @@
+"""Context: worker threads, scheduler installation, taskpool lifecycle.
+
+Mirrors ``/root/reference/parsec/parsec.c`` (``parsec_init``,
+``parsec_fini``) and the context half of ``scheduling.c``
+(``parsec_context_add_taskpool`` :832, ``parsec_context_start`` :935,
+``parsec_context_wait`` :961, worker loop ``__parsec_context_wait`` :694).
+
+Threading model: ``nb_cores`` execution streams; stream 0 belongs to the
+thread calling :meth:`Context.wait` (the reference's master), streams 1..n-1
+get dedicated worker threads created at init.  Workers park on a condition
+variable with exponential-backoff timed waits when idle (the reference uses
+exponential nanosleep, ``scheduling.c:768-771``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..utils import debug, mca_param, open_component
+from . import scheduling
+from .lifecycle import HookReturn
+from .task import Task
+from .taskpool import Taskpool
+
+
+class ExecutionStream:
+    """Per-worker state (reference ``parsec_execution_stream_t``)."""
+
+    __slots__ = ("worker_id", "vp_id", "context", "next_task", "stats", "sched_obj", "profile")
+
+    def __init__(self, worker_id: int, context: "Context", vp_id: int = 0):
+        self.worker_id = worker_id
+        self.vp_id = vp_id
+        self.context = context
+        self.next_task: Optional[Task] = None
+        self.stats: Dict[str, int] = {"executed": 0, "selected": 0, "steals": 0}
+        self.sched_obj = None  # scheduler-private
+        self.profile = None    # profiling stream
+
+
+class Context:
+    """The runtime instance (reference ``parsec_context_t``)."""
+
+    def __init__(
+        self,
+        nb_cores: Optional[int] = None,
+        *,
+        scheduler: Optional[str] = None,
+        devices: Optional[List[str]] = None,
+        rank: int = 0,
+        nranks: int = 1,
+        comm=None,
+    ):
+        if nb_cores is None:
+            nb_cores = mca_param.register(
+                "runtime", "num_cores", min(os.cpu_count() or 1, 8),
+                help="number of worker execution streams",
+            )
+        self.nb_workers = max(1, int(nb_cores))
+        self.rank = rank
+        self.nranks = nranks
+        self.comm = comm  # comm engine (None = single process)
+
+        sched_name = scheduler or str(mca_param.register(
+            "mca", "sched", "", help="scheduler component selection")) or None
+        self.scheduler = open_component("sched", sched_name)
+        self.scheduler.install(self)
+
+        self.streams: List[ExecutionStream] = [
+            ExecutionStream(i, self) for i in range(self.nb_workers)
+        ]
+        for es in self.streams:
+            self.scheduler.flow_init(es)
+
+        # devices (device 0 = CPU; accelerators attach next)
+        from ..device import device as devmod
+
+        self.devices = devmod.attach_devices(self, devices)
+
+        self._cv = threading.Condition()
+        self._taskpools: Dict[int, Taskpool] = {}
+        self._active_taskpools = 0
+        self._started = False
+        self._shutdown = False
+        self._tls = threading.local()
+
+        self._threads: List[threading.Thread] = []
+        for es in self.streams[1:]:
+            t = threading.Thread(target=self._worker_main, args=(es,), name=f"parsec-worker-{es.worker_id}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        debug.verbose(3, "core", "context up: %d workers, sched=%s, devices=%s",
+                      self.nb_workers, self.scheduler.mca_name,
+                      [d.name for d in self.devices])
+        if self.comm is not None:
+            self.comm.attach_context(self)
+
+    # ------------------------------------------------------------------
+    # taskpool lifecycle
+    # ------------------------------------------------------------------
+    def add_taskpool(self, tp: Taskpool) -> None:
+        """Reference ``parsec_context_add_taskpool`` (scheduling.c:832):
+        register, notify comm layer, run the startup hook, enqueue the
+        initially-ready tasks."""
+        with self._cv:
+            self._taskpools[tp.taskpool_id] = tp
+            self._active_taskpools += 1
+        tp.attached(self)
+        if tp.on_enqueue is not None:
+            tp.on_enqueue(tp)
+        if self.comm is not None:
+            self.comm.new_taskpool(tp)
+        # hold a runtime action across ready+startup so an empty-looking pool
+        # cannot declare termination before its startup tasks are accounted
+        tp.tdm.taskpool_addto_runtime_actions(tp, 1)
+        tp.tdm.taskpool_ready(tp)
+        startup = tp.startup(self)
+        if startup:
+            scheduling.schedule_ready(self, None, startup)
+        tp.tdm.taskpool_addto_runtime_actions(tp, -1)
+        self._notify_work()
+
+    def _taskpool_terminated(self, tp: Taskpool) -> None:
+        with self._cv:
+            if tp.taskpool_id in self._taskpools:
+                del self._taskpools[tp.taskpool_id]
+                self._active_taskpools -= 1
+            self._cv.notify_all()
+
+    # ------------------------------------------------------------------
+    # start / wait / test
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        with self._cv:
+            self._started = True
+            self._cv.notify_all()
+
+    def test(self) -> bool:
+        """Non-blocking: True when no active taskpools remain."""
+        self._progress_comm()
+        with self._cv:
+            return self._active_taskpools == 0
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Master joins the work loop until all taskpools quiesce."""
+        self.start()
+        return self._participate(lambda: self._active_taskpools == 0, timeout)
+
+    def wait_taskpool(self, tp: Taskpool, timeout: Optional[float] = None) -> bool:
+        self.start()
+        return self._participate(lambda: tp.is_done(), timeout)
+
+    def _participate(self, done: Callable[[], bool], timeout: Optional[float] = None) -> bool:
+        import time
+
+        es = self.streams[0]
+        self._tls.es = es
+        deadline = (time.monotonic() + timeout) if timeout is not None else None
+        backoff = 1e-6
+        while True:
+            with self._cv:
+                if done():
+                    return True
+                if deadline is not None and time.monotonic() >= deadline:
+                    return False
+            task = self._next_task(es)
+            if task is not None:
+                backoff = 1e-6
+                self._run_task(es, task)
+                continue
+            self._progress_comm()
+            with self._cv:
+                if done():
+                    return True
+                self._cv.wait(backoff)
+            backoff = min(backoff * 2, 1e-3)
+
+    # ------------------------------------------------------------------
+    # worker internals
+    # ------------------------------------------------------------------
+    def _next_task(self, es: ExecutionStream) -> Optional[Task]:
+        task = es.next_task
+        if task is not None:
+            es.next_task = None
+            return task
+        from ..profiling import pins
+
+        pins.fire(pins.SELECT_BEGIN, es, None)
+        task = self.scheduler.select(es)
+        pins.fire(pins.SELECT_END, es, task)
+        if task is not None:
+            es.stats["selected"] += 1
+        return task
+
+    def _worker_main(self, es: ExecutionStream) -> None:
+        self._tls.es = es
+        backoff = 1e-6
+        while True:
+            with self._cv:
+                if self._shutdown:
+                    return
+                if not self._started or self._active_taskpools == 0:
+                    self._cv.wait(0.05)
+                    continue
+            task = self._next_task(es)
+            if task is None:
+                with self._cv:
+                    if self._shutdown:
+                        return
+                    self._cv.wait(backoff)
+                backoff = min(backoff * 2, 1e-3)
+                continue
+            backoff = 1e-6
+            self._run_task(es, task)
+
+    def _run_task(self, es: ExecutionStream, task: Task) -> None:
+        """Progress one task, containing body exceptions: a raising task is
+        reported and retired so the taskpool still quiesces (the reference
+        aborts on hook ERROR; we degrade to a logged error per task)."""
+        es.stats["executed"] += 1
+        try:
+            scheduling.task_progress(self, es, task)
+        except debug.FatalError:
+            raise
+        except Exception as e:
+            debug.error("worker %d: task %r raised: %s", es.worker_id, task, e)
+            import traceback
+
+            traceback.print_exc()
+            task.taskpool.task_done(task)
+
+    def _notify_work(self) -> None:
+        with self._cv:
+            self._cv.notify_all()
+
+    def _progress_comm(self) -> None:
+        if self.comm is not None:
+            self.comm.progress_nonblocking()
+
+    def current_es(self) -> Optional[ExecutionStream]:
+        return getattr(self._tls, "es", None)
+
+    # ------------------------------------------------------------------
+    def schedule(self, tasks, es: Optional[ExecutionStream] = None, distance: int = 0) -> None:
+        """Public entry to make externally-built tasks runnable."""
+        if isinstance(tasks, Task):
+            tasks = [tasks]
+        scheduling.schedule_ready(self, es, tasks, distance)
+
+    def fini(self) -> None:
+        """Reference ``parsec_fini``: drain and tear down."""
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+        if self.comm is not None:
+            self.comm.detach_context(self)
+        from ..device import device as devmod
+
+        devmod.detach_devices(self)
+        self.scheduler.remove(self)
+        debug.verbose(3, "core", "context down")
+
+    # context manager sugar
+    def __enter__(self) -> "Context":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.fini()

@@ -1,0 +1,92 @@
+"""Dependency tracking backends.
+
+Reference: the two per-task-class storage backends for dependency state —
+a dense multidimensional array of counters/masks
+(``parsec_default_find_deps``, ``parsec_internal.h:359``) and a dynamic hash
+table (``parsec_hash_find_deps``, ``:362``) — updated in counter-mode or
+mask-mode (``parsec_internal.h:371-394``).
+
+Here both are a keyed map of small entries; the "dense" variant
+pre-allocates over the task-class iteration space for O(1) lookup without
+hashing. Counter-mode entries become ready when ``count == goal``;
+mask-mode entries when ``mask == goal_mask``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Hashable, Optional, Tuple
+
+
+class DepEntry:
+    __slots__ = ("count", "mask", "data")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.mask = 0
+        self.data: Any = None  # front-end scratch (e.g. param assignment)
+
+
+class DepTracker:
+    """Hash-backed dependency storage, sharded to reduce lock contention
+    (the reference's hash table is bucket-locked, ``parsec_hash_table.c``)."""
+
+    SHARDS = 16
+
+    def __init__(self) -> None:
+        self._shards = [
+            (threading.Lock(), {}) for _ in range(self.SHARDS)
+        ]  # type: list[Tuple[threading.Lock, Dict[Hashable, DepEntry]]]
+
+    def _shard(self, key: Hashable) -> Tuple[threading.Lock, Dict[Hashable, DepEntry]]:
+        return self._shards[hash(key) % self.SHARDS]
+
+    def release_counter(self, key: Hashable, goal: int, data: Any = None) -> Tuple[bool, Any]:
+        """Counter-mode release of one dependency of task ``key``.
+
+        Returns ``(became_ready, entry_data)``. The entry is removed once
+        ready (tasks fire exactly once).
+        """
+        lock, table = self._shard(key)
+        with lock:
+            e = table.get(key)
+            if e is None:
+                e = table[key] = DepEntry()
+            if data is not None:
+                e.data = data
+            e.count += 1
+            if e.count >= goal:
+                del table[key]
+                return True, e.data
+            return False, e.data
+
+    def release_mask(self, key: Hashable, bit: int, goal_mask: int, data: Any = None) -> Tuple[bool, Any]:
+        """Mask-mode release: set ``bit``; ready when all goal bits set."""
+        lock, table = self._shard(key)
+        with lock:
+            e = table.get(key)
+            if e is None:
+                e = table[key] = DepEntry()
+            if data is not None:
+                e.data = data
+            e.mask |= bit
+            if (e.mask & goal_mask) == goal_mask:
+                del table[key]
+                return True, e.data
+            return False, e.data
+
+    def peek(self, key: Hashable) -> Optional[DepEntry]:
+        lock, table = self._shard(key)
+        with lock:
+            return table.get(key)
+
+    def set_data(self, key: Hashable, data: Any) -> None:
+        lock, table = self._shard(key)
+        with lock:
+            e = table.get(key)
+            if e is None:
+                e = table[key] = DepEntry()
+            e.data = data
+
+    def __len__(self) -> int:
+        return sum(len(t) for _, t in self._shards)

@@ -1,0 +1,12 @@
+"""Scheduler components (MCA framework ``sched``).
+
+Reference: ``/root/reference/parsec/mca/sched/`` ships 11 modules sharing the
+vtable ``install/schedule/select/remove`` (``mca/sched/sched.h``).  The
+modules here reproduce the main strategies; the per-thread local-queue +
+steal module (``lfq``) is the default, like the reference.
+"""
+
+from .base import Scheduler
+from . import lfq, gd, ap, ll, rnd, spq  # noqa: F401  (self-registering)
+
+__all__ = ["Scheduler"]

@@ -1,0 +1,39 @@
+"""``rnd`` — random-order global queue (reference ``mca/sched/rnd/
+sched_rnd_module.c:107``): inserts at random positions; a scheduler-
+robustness fuzzer more than a production policy."""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Optional
+
+from ...utils import register_component
+from .base import Scheduler
+
+
+@register_component("sched")
+class SchedRND(Scheduler):
+    mca_name = "rnd"
+    mca_priority = 1
+
+    def install(self, context) -> None:
+        super().install(context)
+        self._items: list = []
+        self._lock = threading.Lock()
+        self._rng = random.Random(0xC0FFEE)
+
+    def schedule(self, es, tasks, distance: int = 0) -> None:
+        with self._lock:
+            for t in tasks:
+                pos = self._rng.randint(0, len(self._items))
+                self._items.insert(pos, t)
+
+    def select(self, es) -> Optional["object"]:
+        with self._lock:
+            if self._items:
+                return self._items.pop()
+        return None
+
+    def pending_estimate(self) -> int:
+        return len(self._items)

@@ -1,0 +1,39 @@
+"""``spq`` — simple priority queue with distance tie-break
+(reference ``mca/sched/spq``): one global heap ordered by (priority desc,
+distance asc, insertion order)."""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import Optional
+
+from ...utils import register_component
+from .base import Scheduler
+
+
+@register_component("sched")
+class SchedSPQ(Scheduler):
+    mca_name = "spq"
+    mca_priority = 3
+
+    def install(self, context) -> None:
+        super().install(context)
+        self._heap: list = []
+        self._lock = threading.Lock()
+        self._seq = itertools.count()
+
+    def schedule(self, es, tasks, distance: int = 0) -> None:
+        with self._lock:
+            for t in tasks:
+                heapq.heappush(self._heap, (-t.priority, distance, next(self._seq), t))
+
+    def select(self, es) -> Optional["object"]:
+        with self._lock:
+            if self._heap:
+                return heapq.heappop(self._heap)[3]
+        return None
+
+    def pending_estimate(self) -> int:
+        return len(self._heap)

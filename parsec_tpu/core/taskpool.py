@@ -1,0 +1,106 @@
+"""Taskpool: a DAG-in-execution attached to a context.
+
+Reference: ``parsec_taskpool_t`` (``/root/reference/parsec/parsec_internal.h:
+121-167``) — holds task classes, a termination-detection monitor, startup
+hook, completion callbacks, and an id registered with the context so remote
+activations can name it.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Dict, List, Optional, TYPE_CHECKING
+
+from ..utils import debug, open_component
+from .task import Task, TaskClass
+from .termdet import TermDetMonitor
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .context import Context
+
+
+class Taskpool:
+    """Base taskpool. Front-ends subclass (PTG/DTD) or instantiate directly
+    for hand-built DAGs."""
+
+    _ids = itertools.count(1)
+
+    # taskpool type tags (reference parsec_internal.h:112-115)
+    TYPE_PTG = "ptg"
+    TYPE_DTD = "dtd"
+    TYPE_COMPOUND = "compound"
+    TYPE_USER = "user"
+
+    def __init__(
+        self,
+        name: str = "taskpool",
+        *,
+        termdet: Optional[str] = None,
+        nb_tasks: Optional[int] = None,
+    ):
+        self.name = name
+        self.taskpool_id: int = next(self._ids)
+        self.taskpool_type = self.TYPE_USER
+        self.context: Optional["Context"] = None
+        self.task_classes: Dict[int, TaskClass] = {}
+        self.tdm: TermDetMonitor = open_component("termdet", termdet)
+        self.tdm.monitor_taskpool(self, self._termination_detected)
+        self._terminated = threading.Event()
+        self.on_enqueue: Optional[Callable[["Taskpool"], None]] = None
+        self.on_complete: Optional[Callable[["Taskpool"], None]] = None
+        #: front-end startup hook: enumerate initially-ready tasks
+        self.startup_hook: Optional[Callable[["Context", "Taskpool"], List[Task]]] = None
+        self._known_nb_tasks = nb_tasks
+        #: auto-count mode: pools with no declared task count are accounted
+        #: automatically — +1 when a task is first scheduled, -1 on retire.
+        #: Front-ends that manage counters themselves set this False.
+        self.auto_count = nb_tasks is None
+        self.priority: int = 0
+        self.user: Any = None
+
+    # -- task classes -----------------------------------------------------
+    def add_task_class(self, tc: TaskClass) -> TaskClass:
+        self.task_classes[tc.task_class_id] = tc
+        return tc
+
+    # -- lifecycle --------------------------------------------------------
+    def attached(self, context: "Context") -> None:
+        """Called by ``Context.add_taskpool``."""
+        self.context = context
+        if self._known_nb_tasks is not None:
+            self.tdm.taskpool_set_nb_tasks(self, self._known_nb_tasks)
+
+    def startup(self, context: "Context") -> List[Task]:
+        if self.startup_hook is not None:
+            return list(self.startup_hook(context, self))
+        return []
+
+    def _termination_detected(self, tp: "Taskpool") -> None:
+        debug.verbose(4, "core", "taskpool %s(%d) terminated", self.name, self.taskpool_id)
+        self._terminated.set()
+        if self.context is not None:
+            self.context._taskpool_terminated(self)
+        if self.on_complete is not None:
+            self.on_complete(self)
+
+    def task_done(self, task: Optional[Task] = None) -> None:
+        """Retire one task (drives termination detection)."""
+        self.tdm.taskpool_addto_nb_tasks(self, -1)
+
+    def is_done(self) -> bool:
+        return self._terminated.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block the caller until this taskpool quiesces
+        (reference ``parsec_taskpool_wait``, ``scheduling.c:995``)."""
+        if self.context is not None:
+            return self.context.wait_taskpool(self, timeout=timeout)
+        return self._terminated.wait(timeout)
+
+    # -- helpers ----------------------------------------------------------
+    def new_task(self, tc: TaskClass, locals_=(), priority: int = 0) -> Task:
+        return Task(self, tc, locals_, priority)
+
+    def __repr__(self) -> str:
+        return f"Taskpool({self.name}#{self.taskpool_id})"

@@ -1,0 +1,6 @@
+"""Device layer (reference L4): registry, selection, CPU + TPU modules."""
+
+from . import device
+from .device import CpuDevice, Device, select_best_device
+
+__all__ = ["device", "Device", "CpuDevice", "select_best_device"]

@@ -1,0 +1,194 @@
+"""Device registry and best-device selection.
+
+Reference: ``/root/reference/parsec/mca/device/device.{c,h}`` — device 0 is
+the CPU-cores device, accelerators attach after; per-task placement picks the
+device minimizing estimated-time-of-availability (device load + per-task
+time estimate, with a load-balance skew factor), after honouring data
+affinity: if a task's data is already resident on an accelerator, prefer it
+(``parsec_select_best_device``, ``device.c:92-266``, skew ``:54-60``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, TYPE_CHECKING
+
+from ..utils import Component, debug, mca_param, register_component
+from ..core.lifecycle import DEV_CPU, HookReturn
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..core.context import Context
+    from ..core.task import Task
+
+
+class Device(Component):
+    """Base device module (reference device vtable, ``device.h:142-158``)."""
+
+    mca_type = "device"
+    device_type: str = DEV_CPU
+
+    def __init__(self, context: "Context", index: int):
+        self.context = context
+        self.index = index
+        self.name = f"{self.mca_name}{index}"
+        self._load_lock = threading.Lock()
+        #: estimated completion horizon (seconds of queued work)
+        self.device_load: float = 0.0
+        #: relative throughput weight used by the default time estimate;
+        #: reference derives GFLOPS ratings per device
+        self.gflops_rating: float = 1.0
+        self.stats: Dict[str, int] = {
+            "executed_tasks": 0,
+            "bytes_in": 0,
+            "bytes_out": 0,
+            "evictions": 0,
+        }
+        self.enabled = True
+
+    # -- vtable ---------------------------------------------------------
+    def attach(self) -> None:
+        pass
+
+    def detach(self) -> None:
+        pass
+
+    def taskpool_register(self, tp) -> None:
+        pass
+
+    def memory_register(self, data) -> None:
+        pass
+
+    def memory_unregister(self, data) -> None:
+        pass
+
+    def time_estimate(self, task: "Task") -> float:
+        """Seconds this task would take here (lower = better)."""
+        tc = task.task_class
+        if tc.time_estimate is not None:
+            return tc.time_estimate(task, self)
+        return 1e-4 / self.gflops_rating
+
+    def kernel_scheduler(self, es, task: "Task") -> HookReturn:
+        """Accelerators override: take ownership of the task (ASYNC)."""
+        raise NotImplementedError
+
+    def add_load(self, dt: float) -> None:
+        with self._load_lock:
+            self.device_load += dt
+
+    def sub_load(self, dt: float) -> None:
+        with self._load_lock:
+            self.device_load = max(0.0, self.device_load - dt)
+
+    def resident_data(self, task: "Task") -> int:
+        """Bytes of this task's input data already resident here (affinity)."""
+        return 0
+
+
+@register_component("device")
+class CpuDevice(Device):
+    """Device 0: the worker cores themselves. CPU chores run inline in the
+    calling worker, so the kernel_scheduler is never used."""
+
+    mca_name = "cpu"
+    mca_priority = 100
+    device_type = DEV_CPU
+
+    def kernel_scheduler(self, es, task):  # pragma: no cover - inline exec
+        raise AssertionError("CPU chores execute inline")
+
+
+def attach_devices(context: "Context", names: Optional[List[str]] = None) -> List[Device]:
+    """Instantiate the CPU device plus every available accelerator module
+    (reference ``parsec_mca_device_init``/``attach``, ``parsec.c:809-815``)."""
+    from ..utils import components_of_type
+
+    sel = names
+    if sel is None:
+        sel_param = str(mca_param.register(
+            "device", "enabled", "", help="comma list of device modules (empty=all available)"))
+        sel = [s.strip() for s in sel_param.split(",") if s.strip()] or None
+
+    devices: List[Device] = []
+    for cls in components_of_type("device"):
+        if sel is not None and cls.mca_name not in sel and cls.mca_name != "cpu":
+            continue
+        if not cls.available():
+            continue
+        try:
+            dev = cls(context, len(devices))
+            dev.attach()
+            devices.append(dev)
+        except Exception as e:
+            debug.warning("device %s failed to attach: %s", cls.mca_name, e)
+    if not devices or devices[0].device_type != DEV_CPU:
+        raise RuntimeError("CPU device must attach first")
+    context._device_skew = mca_param.register(
+        "device", "load_balance_skew", 0.9,
+        help="multiplier applied to accelerator ETAs (<1 favours accelerators)",
+    )
+    return devices
+
+
+def detach_devices(context: "Context") -> None:
+    for dev in getattr(context, "devices", []):
+        try:
+            dev.detach()
+        except Exception as e:  # teardown must not raise
+            debug.warning("device %s detach failed: %s", dev.name, e)
+
+
+def select_best_device(context: "Context", task: "Task") -> HookReturn:
+    """Pick (device, chore) for a ready task; reference ``device.c:92-266``.
+
+    Order of criteria:
+      1. data affinity — an accelerator already holding the task's inputs
+         wins outright (saves HBM traffic);
+      2. minimal ETA = device_load + time_estimate, accelerators discounted
+         by the load-balance skew parameter.
+    """
+    tc = task.task_class
+    skew = getattr(context, "_device_skew", 0.9)
+    eligible = []
+    for dev in context.devices:
+        if not dev.enabled:
+            continue
+        for ci, chore in enumerate(tc.chores):
+            if not chore.enabled or chore.device_type != dev.device_type:
+                continue
+            if not (task.chore_mask & (1 << ci)):
+                continue
+            if chore.evaluate is not None and not chore.evaluate(task):
+                continue
+            eligible.append((dev, chore, ci))
+            break
+    if not eligible:
+        return HookReturn.NEXT
+
+    # 1. affinity
+    best = None
+    best_bytes = 0
+    for dev, chore, ci in eligible:
+        if dev.device_type == DEV_CPU:
+            continue
+        rb = dev.resident_data(task)
+        if rb > best_bytes:
+            best, best_bytes = (dev, chore, ci), rb
+    # 2. ETA
+    if best is None:
+        best_eta = None
+        for dev, chore, ci in eligible:
+            est = chore.time_estimate(task, dev) if chore.time_estimate else dev.time_estimate(task)
+            eta = dev.device_load + est
+            if dev.device_type != DEV_CPU:
+                eta *= skew
+            if best_eta is None or eta < best_eta:
+                best_eta, best = eta, (dev, chore, ci)
+    dev, chore, ci = best
+    task.selected_device = dev
+    task.selected_chore = chore
+    task.selected_chore_idx = ci
+    est = chore.time_estimate(task, dev) if chore.time_estimate else dev.time_estimate(task)
+    dev.add_load(est)
+    task.prof["est"] = est
+    return HookReturn.DONE

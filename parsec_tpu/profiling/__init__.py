@@ -1,0 +1,5 @@
+"""Observability layer (reference L7): PINS hooks, trace, DOT grapher."""
+
+from . import pins
+
+__all__ = ["pins"]

@@ -1,0 +1,67 @@
+"""PINS — Performance INStrumentation callback sites.
+
+Reference: ``/root/reference/parsec/mca/pins/pins.h:26-55`` defines 13
+begin/end callback flags fired from the scheduling core; modules subscribe
+per-site.  Here ``fire`` is a near-no-op unless at least one subscriber is
+registered for the site (the reference gates with an enable mask,
+``pins.h:161-171``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+# callback sites (reference PARSEC_PINS_FLAG enum)
+SELECT_BEGIN = "select_begin"
+SELECT_END = "select_end"
+PREPARE_INPUT_BEGIN = "prepare_input_begin"
+PREPARE_INPUT_END = "prepare_input_end"
+RELEASE_DEPS_BEGIN = "release_deps_begin"
+RELEASE_DEPS_END = "release_deps_end"
+ACTIVATE_CB_BEGIN = "activate_cb_begin"
+ACTIVATE_CB_END = "activate_cb_end"
+DATA_FLUSH_BEGIN = "data_flush_begin"
+DATA_FLUSH_END = "data_flush_end"
+EXEC_BEGIN = "exec_begin"
+EXEC_END = "exec_end"
+COMPLETE_EXEC_BEGIN = "complete_exec_begin"
+COMPLETE_EXEC_END = "complete_exec_end"
+SCHEDULE_BEGIN = "schedule_begin"
+SCHEDULE_END = "schedule_end"
+
+ALL_SITES = [v for k, v in list(globals().items()) if k.isupper() and isinstance(v, str)]
+
+_subscribers: Dict[str, List[Callable[..., None]]] = {}
+_enabled = False
+
+
+def subscribe(site: str, cb: Callable[..., None]) -> None:
+    global _enabled
+    _subscribers.setdefault(site, []).append(cb)
+    _enabled = True
+
+
+def unsubscribe(site: str, cb: Callable[..., None]) -> None:
+    global _enabled
+    lst = _subscribers.get(site)
+    if lst and cb in lst:
+        lst.remove(cb)
+    _enabled = any(_subscribers.values())
+
+
+def fire(site: str, es: Any, payload: Any) -> None:
+    if not _enabled:
+        return
+    for cb in _subscribers.get(site, ()):  # pragma: no branch
+        try:
+            cb(es, payload)
+        except Exception as e:  # instrumentation must never kill the run
+            from ..utils import debug
+
+            debug.warning("pins callback for %s raised: %s", site, e)
+
+
+def clear() -> None:
+    global _enabled
+    _subscribers.clear()
+    _enabled = False

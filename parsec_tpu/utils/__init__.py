@@ -1,0 +1,24 @@
+"""L0 utilities: parameter registry, debug streams, component registry.
+
+Equivalent layer to the reference's ``parsec/class`` + ``parsec/utils``
+(see SURVEY.md §2.1).  Pieces of the reference that exist only to compensate
+for C (refcounted object model, intrusive lock-free lists, per-arch atomics,
+mempools) are deliberately *not* re-implemented: Python objects, ``deque``,
+``queue`` and the GIL-free JAX dispatch path cover those roles; the hot
+scheduler queues live in the scheduler components themselves.
+"""
+
+from . import debug, mca_param
+from .components import Component, component_names, components_of_type, open_component, register_component
+from .mca_param import params
+
+__all__ = [
+    "debug",
+    "mca_param",
+    "params",
+    "Component",
+    "register_component",
+    "open_component",
+    "components_of_type",
+    "component_names",
+]

@@ -1,0 +1,74 @@
+"""Component-registry unit tests."""
+
+import pytest
+
+from parsec_tpu.utils import (
+    Component,
+    component_names,
+    components_of_type,
+    open_component,
+    register_component,
+    mca_param,
+)
+from parsec_tpu.utils.debug import FatalError
+
+
+@register_component("_testfw")
+class CompA(Component):
+    mca_name = "a"
+    mca_priority = 1
+
+
+@register_component("_testfw")
+class CompB(Component):
+    mca_name = "b"
+    mca_priority = 9
+
+
+@register_component("_testfw")
+class CompUnavail(Component):
+    mca_name = "c"
+    mca_priority = 100
+
+    @classmethod
+    def available(cls):
+        return False
+
+
+def test_priority_selection():
+    # c has top priority but is unavailable -> b wins
+    assert isinstance(open_component("_testfw"), CompB)
+
+
+def test_named_selection():
+    assert isinstance(open_component("_testfw", "a"), CompA)
+
+
+def test_unknown_name_fatal():
+    with pytest.raises(FatalError):
+        open_component("_testfw", "nope")
+
+
+def test_unavailable_fatal():
+    with pytest.raises(FatalError):
+        open_component("_testfw", "c")
+
+
+def test_mca_selection_param():
+    mca_param.set_param("mca", "_testfw", "a")
+    try:
+        comps = components_of_type("_testfw")
+        assert [c.mca_name for c in comps] == ["a"]
+    finally:
+        mca_param.params.unset("mca", "_testfw")
+
+
+def test_component_names():
+    assert set(component_names("_testfw")) == {"a", "b", "c"}
+
+
+def test_sched_components_registered():
+    import parsec_tpu.core  # noqa: F401
+
+    names = set(component_names("sched"))
+    assert {"lfq", "gd", "ap", "ll", "rnd", "spq"} <= names

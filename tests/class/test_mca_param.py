@@ -1,0 +1,53 @@
+"""Parameter-registry unit tests (reference tests/class shape)."""
+
+import os
+
+from parsec_tpu.utils import mca_param
+
+
+def test_register_default():
+    v = mca_param.register("testfw", "alpha", 42, help="x")
+    assert v == 42
+    assert mca_param.get("testfw", "alpha") == 42
+
+
+def test_set_overrides_default():
+    mca_param.register("testfw", "beta", 1)
+    mca_param.set_param("testfw", "beta", 7)
+    assert mca_param.get("testfw", "beta") == 7
+    mca_param.params.unset("testfw", "beta")
+    assert mca_param.get("testfw", "beta") == 1
+
+
+def test_env_layer(monkeypatch):
+    monkeypatch.setenv("PARSEC_MCA_testfw_gamma", "99")
+    v = mca_param.register("testfw", "gamma", 5)
+    assert v == 99
+
+
+def test_bool_coercion(monkeypatch):
+    monkeypatch.setenv("PARSEC_MCA_testfw_flag", "true")
+    assert mca_param.register("testfw", "flag", False) is True
+
+
+def test_cmdline_parse():
+    rest = mca_param.parse_cmdline(["prog", "--mca", "testfw_delta", "3", "pos"])
+    assert rest == ["prog", "pos"]
+    mca_param.register("testfw", "delta", 0)
+    assert mca_param.get("testfw", "delta") == 3
+
+
+def test_param_file(tmp_path):
+    f = tmp_path / "params.conf"
+    f.write_text("# comment\ntestfw_filep = 11\n")
+    mca_param.register("testfw", "filep", 2)
+    n = mca_param.load_file(str(f))
+    assert n == 1
+    assert mca_param.get("testfw", "filep") == 11
+
+
+def test_dump_contains_registered():
+    mca_param.register("testfw", "dumped", 1, help="the help")
+    entries = {e["name"]: e for e in mca_param.dump()}
+    assert "testfw_dumped" in entries
+    assert entries["testfw_dumped"]["help"] == "the help"

@@ -1,0 +1,25 @@
+"""Test harness configuration.
+
+Multi-chip tests run on a virtual 8-device CPU mesh: the env vars must be
+set before the first ``import jax`` anywhere in the process (mirrors the
+reference's strategy of testing "multi-node" as multi-process on one node,
+``SURVEY.md §4``).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS",
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8",
+)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _quiet_debug():
+    from parsec_tpu.utils import debug
+
+    debug.set_verbose(1)
+    yield

@@ -1,0 +1,193 @@
+"""Hand-built DAG tests through the raw core (no DSL).
+
+Covers the reference's runtime-level behaviors: sequential chain
+(Ex02_Chain shape), fan-out/fan-in with counter deps, priorities, AGAIN
+rescheduling, every scheduler component, and compound composition.
+"""
+
+import threading
+
+import pytest
+
+from parsec_tpu import (
+    Chore,
+    CompoundTaskpool,
+    Context,
+    DEV_CPU,
+    HookReturn,
+    Task,
+    TaskClass,
+    Taskpool,
+    compose,
+)
+from parsec_tpu.core.deps import DepTracker
+
+
+def make_chain_taskpool(n, log, lock):
+    tp = Taskpool("chain", nb_tasks=n)
+
+    def body(es, task):
+        with lock:
+            log.append(task.locals[0])
+        return HookReturn.DONE
+
+    tc = TaskClass("step", chores=[Chore(DEV_CPU, body)], nb_parameters=1)
+
+    def release_deps(es, task):
+        k = task.locals[0]
+        if k + 1 < n:
+            return [Task(tp, tc, (k + 1,))]
+        return []
+
+    tc.release_deps = release_deps
+    tp.add_task_class(tc)
+    tp.startup_hook = lambda ctx, tp_: [Task(tp_, tc, (0,))]
+    return tp
+
+
+@pytest.mark.parametrize("nb_cores", [1, 4])
+def test_chain_runs_in_order(nb_cores):
+    log, lock = [], threading.Lock()
+    with Context(nb_cores=nb_cores) as ctx:
+        tp = make_chain_taskpool(50, log, lock)
+        ctx.add_taskpool(tp)
+        assert ctx.wait(timeout=30)
+    assert log == list(range(50))
+
+
+@pytest.mark.parametrize("sched", ["lfq", "gd", "ap", "ll", "rnd", "spq"])
+def test_all_schedulers_run_fanout(sched):
+    """Diamond: root -> N middles -> sink, counter-mode dep on the sink."""
+    n = 64
+    done = []
+    lock = threading.Lock()
+    tp = Taskpool("fanout", nb_tasks=n + 2)
+    deps = DepTracker()
+
+    def root_body(es, task):
+        return HookReturn.DONE
+
+    def mid_body(es, task):
+        with lock:
+            done.append(task.locals[0])
+        return HookReturn.DONE
+
+    def sink_body(es, task):
+        with lock:
+            done.append("sink")
+        return HookReturn.DONE
+
+    sink_tc = TaskClass("sink", chores=[Chore(DEV_CPU, sink_body)])
+    mid_tc = TaskClass("mid", chores=[Chore(DEV_CPU, mid_body)], nb_parameters=1)
+    root_tc = TaskClass("root", chores=[Chore(DEV_CPU, root_body)])
+
+    def root_release(es, task):
+        return [Task(tp, mid_tc, (i,), priority=i) for i in range(n)]
+
+    def mid_release(es, task):
+        ready, _ = deps.release_counter(("sink",), n)
+        return [Task(tp, sink_tc)] if ready else []
+
+    root_tc.release_deps = root_release
+    mid_tc.release_deps = mid_release
+    for tc in (root_tc, mid_tc, sink_tc):
+        tp.add_task_class(tc)
+    tp.startup_hook = lambda ctx, tp_: [Task(tp_, root_tc)]
+
+    with Context(nb_cores=4, scheduler=sched) as ctx:
+        ctx.add_taskpool(tp)
+        assert ctx.wait(timeout=30)
+    assert done[-1] == "sink"
+    assert sorted(done[:-1]) == list(range(n))
+
+
+def test_again_reschedules():
+    """A task returning AGAIN runs again later (scheduling.c:495-502)."""
+    attempts = []
+    tp = Taskpool("again", nb_tasks=1)
+
+    def body(es, task):
+        attempts.append(1)
+        if len(attempts) < 3:
+            return HookReturn.AGAIN
+        return HookReturn.DONE
+
+    tc = TaskClass("flaky", chores=[Chore(DEV_CPU, body)])
+    tp.add_task_class(tc)
+    tp.startup_hook = lambda ctx, tp_: [Task(tp_, tc, priority=5)]
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(tp)
+        assert ctx.wait(timeout=30)
+    assert len(attempts) == 3
+
+
+def test_compose_sequences_taskpools():
+    order = []
+    lock = threading.Lock()
+
+    def mk(tag):
+        tp = Taskpool(tag, nb_tasks=1)
+
+        def body(es, task):
+            with lock:
+                order.append(tag)
+            return HookReturn.DONE
+
+        tc = TaskClass(tag, chores=[Chore(DEV_CPU, body)])
+        tp.add_task_class(tc)
+        tp.startup_hook = lambda ctx, tp_: [Task(tp_, tc)]
+        return tp
+
+    comp = compose(compose(mk("a"), mk("b")), mk("c"))
+    assert isinstance(comp, CompoundTaskpool)
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(comp)
+        assert ctx.wait(timeout=30)
+    assert order == ["a", "b", "c"]
+
+
+def test_taskpool_wait_scoped():
+    """parsec_taskpool_wait: waiting on one pool while another is active."""
+    tp1 = make_chain_taskpool(10, [], threading.Lock())
+    log2, lock2 = [], threading.Lock()
+    tp2 = make_chain_taskpool(200, log2, lock2)
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(tp2)
+        ctx.add_taskpool(tp1)
+        assert tp1.wait(timeout=30)
+        assert tp1.is_done()
+        assert ctx.wait(timeout=30)
+        assert tp2.is_done()
+    assert log2 == list(range(200))
+
+
+def test_dynamic_task_counts():
+    """Taskpool whose task count is discovered at runtime (DTD shape):
+    nb_tasks grows as tasks are inserted from within tasks."""
+    tp = Taskpool("dyn")
+    tp.tdm.taskpool_set_nb_tasks(tp, 1)  # the root
+    seen = []
+    lock = threading.Lock()
+    tc = TaskClass("t", nb_parameters=1)
+
+    def body(es, task):
+        k = task.locals[0]
+        with lock:
+            seen.append(k)
+        return HookReturn.DONE
+
+    def release(es, task):
+        k = task.locals[0]
+        if k < 20:
+            tp.tdm.taskpool_addto_nb_tasks(tp, 1)
+            return [Task(tp, tc, (k + 1,))]
+        return []
+
+    tc.chores.append(Chore(DEV_CPU, body))
+    tc.release_deps = release
+    tp.add_task_class(tc)
+    tp.startup_hook = lambda ctx, tp_: [Task(tp_, tc, (0,))]
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(tp)
+        assert ctx.wait(timeout=30)
+    assert seen == list(range(21))

@@ -1,0 +1,143 @@
+"""Regression tests for lifecycle edge cases found in review:
+NEXT chore advancement, DISABLE handling, auto-count termination,
+cmdline component selection, device-load accounting."""
+
+import threading
+
+import pytest
+
+from parsec_tpu import (
+    Chore,
+    Context,
+    DEV_CPU,
+    HookReturn,
+    Task,
+    TaskClass,
+    Taskpool,
+)
+from parsec_tpu.utils import mca_param
+from parsec_tpu.utils.debug import FatalError
+
+
+def test_auto_count_pool_waits_for_all_tasks():
+    """A Taskpool with no declared nb_tasks must not terminate before its
+    dynamically discovered tasks retire."""
+    import time
+
+    done = []
+    lock = threading.Lock()
+    tp = Taskpool("auto")  # no nb_tasks => auto-count mode
+    assert tp.auto_count
+
+    def body(es, task):
+        time.sleep(0.005)  # make instant-termination races observable
+        with lock:
+            done.append(task.locals[0])
+        return HookReturn.DONE
+
+    tc = TaskClass("t", chores=[Chore(DEV_CPU, body)], nb_parameters=1)
+
+    def release(es, task):
+        k = task.locals[0]
+        return [Task(tp, tc, (k + 1,))] if k + 1 < 10 else []
+
+    tc.release_deps = release
+    tp.add_task_class(tc)
+    tp.startup_hook = lambda ctx, tp_: [Task(tp_, tc, (0,))]
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(tp)
+        assert ctx.wait(timeout=30)
+    assert done == list(range(10))  # ALL tasks ran before wait returned
+
+
+def test_next_advances_to_next_chore():
+    """A chore returning NEXT must be masked out; the next chore runs."""
+    calls = []
+    tp = Taskpool("next", nb_tasks=1)
+
+    def decliner(es, task):
+        calls.append("declined")
+        return HookReturn.NEXT
+
+    def acceptor(es, task):
+        calls.append("ran")
+        return HookReturn.DONE
+
+    tc = TaskClass("t", chores=[Chore(DEV_CPU, decliner), Chore(DEV_CPU, acceptor)])
+    tp.add_task_class(tc)
+    tp.startup_hook = lambda ctx, tp_: [Task(tp_, tc)]
+    with Context(nb_cores=1) as ctx:
+        ctx.add_taskpool(tp)
+        assert ctx.wait(timeout=30)
+    assert calls == ["declined", "ran"]
+
+
+def test_all_chores_decline_is_fatal():
+    tp = Taskpool("allnext", nb_tasks=1)
+    tc = TaskClass("t", chores=[Chore(DEV_CPU, lambda es, t: HookReturn.NEXT)])
+    tp.add_task_class(tc)
+    tp.startup_hook = lambda ctx, tp_: [Task(tp_, tc)]
+    with Context(nb_cores=1) as ctx:
+        ctx.add_taskpool(tp)
+        with pytest.raises(FatalError):
+            ctx.wait(timeout=10)
+
+
+def test_disable_chore_reroutes():
+    """DISABLE on a CPU chore disables it; the second chore takes over for
+    the rescheduled task and subsequent ones."""
+    calls = []
+    tp = Taskpool("disable", nb_tasks=2)
+
+    def bad(es, task):
+        calls.append("bad")
+        return HookReturn.DISABLE
+
+    def good(es, task):
+        calls.append("good")
+        return HookReturn.DONE
+
+    tc = TaskClass("t", chores=[Chore(DEV_CPU, bad), Chore(DEV_CPU, good)], nb_parameters=1)
+    tp.add_task_class(tc)
+    tp.startup_hook = lambda ctx, tp_: [Task(tp_, tc, (0,)), Task(tp_, tc, (1,))]
+    with Context(nb_cores=1) as ctx:
+        ctx.add_taskpool(tp)
+        assert ctx.wait(timeout=30)
+    assert calls.count("good") == 2
+    assert calls.count("bad") == 1  # disabled after first DISABLE
+
+
+def test_cmdline_component_selection():
+    """Reference form ``--mca sched gd`` selects the scheduler."""
+    rest = mca_param.parse_cmdline(["prog", "--mca", "sched", "gd"])
+    assert rest == ["prog"]
+    try:
+        with Context(nb_cores=1) as ctx:
+            assert ctx.scheduler.mca_name == "gd"
+    finally:
+        mca_param.params.unset("mca", "sched")
+
+
+def test_cmdline_missing_value_not_crash():
+    rest = mca_param.parse_cmdline(["--mca", "orphan_key"])
+    assert rest == ["--mca", "orphan_key"]
+
+
+def test_device_load_balanced_after_again():
+    """AGAIN retries must not leak reserved device load."""
+    attempts = []
+    tp = Taskpool("load", nb_tasks=1)
+
+    def body(es, task):
+        attempts.append(1)
+        return HookReturn.AGAIN if len(attempts) < 3 else HookReturn.DONE
+
+    tc = TaskClass("t", chores=[Chore(DEV_CPU, body)])
+    tp.add_task_class(tc)
+    tp.startup_hook = lambda ctx, tp_: [Task(tp_, tc)]
+    with Context(nb_cores=1) as ctx:
+        ctx.add_taskpool(tp)
+        assert ctx.wait(timeout=30)
+        cpu = ctx.devices[0]
+        assert cpu.device_load == pytest.approx(0.0)
+        assert cpu.stats["executed_tasks"] == 1
