@@ -80,6 +80,9 @@ class Context:
         self.devices = devmod.attach_devices(self, devices)
 
         self._cv = threading.Condition()
+        #: exclusive ownership of execution stream 0 (the "master" stream):
+        #: contended between a wait()-ing thread and non-worker helpers
+        self._es0_lock = threading.Lock()
         self._taskpools: Dict[int, Taskpool] = {}
         self._active_taskpools = 0
         self._started = False
@@ -155,27 +158,38 @@ class Context:
     def _participate(self, done: Callable[[], bool], timeout: Optional[float] = None) -> bool:
         import time
 
-        es = self.streams[0]
-        self._tls.es = es
+        es = self.current_es()
+        own_es0 = False
+        if es is None:
+            # claim stream 0; if another thread drives it, wait passively
+            own_es0 = self._es0_lock.acquire(blocking=False)
+            es = self.streams[0] if own_es0 else None
+            if own_es0:
+                self._tls.es = es
         deadline = (time.monotonic() + timeout) if timeout is not None else None
         backoff = 1e-6
-        while True:
-            with self._cv:
-                if done():
-                    return True
-                if deadline is not None and time.monotonic() >= deadline:
-                    return False
-            task = self._next_task(es)
-            if task is not None:
-                backoff = 1e-6
-                self._run_task(es, task)
-                continue
-            self._progress_comm()
-            with self._cv:
-                if done():
-                    return True
-                self._cv.wait(backoff)
-            backoff = min(backoff * 2, 1e-3)
+        try:
+            while True:
+                with self._cv:
+                    if done():
+                        return True
+                    if deadline is not None and time.monotonic() >= deadline:
+                        return False
+                task = self._next_task(es) if es is not None else None
+                if task is not None:
+                    backoff = 1e-6
+                    self._run_task(es, task)
+                    continue
+                self._progress_comm()
+                with self._cv:
+                    if done():
+                        return True
+                    self._cv.wait(backoff)
+                backoff = min(backoff * 2, 1e-3)
+        finally:
+            if own_es0:
+                self._tls.es = None
+                self._es0_lock.release()
 
     # ------------------------------------------------------------------
     # worker internals
@@ -229,7 +243,18 @@ class Context:
             import traceback
 
             traceback.print_exc()
-            task.taskpool.task_done(task)
+            # run the completion side anyway: successors must be released and
+            # completion callbacks fired or the taskpool never quiesces
+            from .lifecycle import TaskStatus
+
+            if task.status < TaskStatus.PREPARE_OUTPUT:
+                try:
+                    scheduling.complete_execution(self, es, task)
+                except Exception as e2:
+                    debug.error("completion of failed task %r also raised: %s", task, e2)
+                    task.taskpool.task_done(task)
+            else:  # raised inside the completion path: just retire
+                task.taskpool.task_done(task)
 
     def _notify_work(self) -> None:
         with self._cv:
@@ -241,6 +266,32 @@ class Context:
 
     def current_es(self) -> Optional[ExecutionStream]:
         return getattr(self._tls, "es", None)
+
+    def help_execute_one(self) -> bool:
+        """Execute one ready task on the calling thread if safely possible
+        (used by DTD window throttling). Worker threads use their own
+        stream; other threads borrow stream 0 under its ownership lock.
+        Returns True if a task ran."""
+        es = self.current_es()
+        if es is not None:
+            task = self._next_task(es)
+            if task is not None:
+                self._run_task(es, task)
+                return True
+            return False
+        if not self._es0_lock.acquire(blocking=False):
+            return False  # someone else drives stream 0; let them progress
+        try:
+            es = self.streams[0]
+            self._tls.es = es
+            task = self._next_task(es)
+            if task is not None:
+                self._run_task(es, task)
+                return True
+            return False
+        finally:
+            self._tls.es = None
+            self._es0_lock.release()
 
     # ------------------------------------------------------------------
     def schedule(self, tasks, es: Optional[ExecutionStream] = None, distance: int = 0) -> None:

@@ -41,7 +41,7 @@ class Chore:
     ``parsec_internal.h:396-402``): a device type + hook, with an optional
     ``evaluate`` predicate deciding applicability per task."""
 
-    __slots__ = ("device_type", "hook", "evaluate", "enabled", "time_estimate")
+    __slots__ = ("device_type", "hook", "evaluate", "enabled", "time_estimate", "body_fn")
 
     def __init__(
         self,
@@ -55,6 +55,9 @@ class Chore:
         self.evaluate = evaluate
         self.enabled = True
         self.time_estimate = time_estimate
+        #: raw functional body for device execution (set by front-ends for
+        #: accelerator chores; the device module jits and dispatches it)
+        self.body_fn = None
 
 
 class TaskClass:

@@ -1,0 +1,19 @@
+"""Data substrate (reference L1): data/copies/coherency, arenas, repos,
+collections."""
+
+from .data import Coherency, Data, DataCopy, data_create
+from .arena import Arena
+from .datarepo import DataRepo, RepoEntry
+from .collection import DataCollection, LocalCollection
+
+__all__ = [
+    "Coherency",
+    "Data",
+    "DataCopy",
+    "data_create",
+    "Arena",
+    "DataRepo",
+    "RepoEntry",
+    "DataCollection",
+    "LocalCollection",
+]

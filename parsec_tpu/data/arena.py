@@ -1,0 +1,73 @@
+"""Arenas: recycled allocators for temporary (network/scratch) buffers.
+
+Reference: ``/root/reference/parsec/arena.{c,h}`` — one arena per
+(datatype, shape); allocations are cached on a freelist up to
+``arena_max_cached`` and capped at ``arena_max_used`` outstanding
+(``parsec.c:656-665`` MCA params).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import mca_param
+from .data import Data, DataCopy
+
+
+class Arena:
+    """Fixed-shape buffer pool. ``allocate()`` returns a DataCopy wrapping a
+    recycled or fresh numpy buffer; ``release()`` returns it to the cache."""
+
+    def __init__(self, shape: Tuple[int, ...], dtype=np.float64, name: str = "arena"):
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.name = name
+        self._free: List[np.ndarray] = []
+        self._lock = threading.Lock()
+        self.max_cached = mca_param.register(
+            "runtime", "arena_max_cached", 64,
+            help="max buffers cached per arena freelist")
+        self.max_used = mca_param.register(
+            "runtime", "arena_max_used", 0,
+            help="max outstanding buffers per arena (0=unlimited)")
+        self.nb_used = 0
+        self.nb_created = 0
+
+    @property
+    def elt_nbytes(self) -> int:
+        return int(np.prod(self.shape)) * self.dtype.itemsize
+
+    def allocate(self, key: Any = None) -> Optional[DataCopy]:
+        """Returns None when max_used is reached (caller retries later —
+        the reference returns NULL and the comm engine re-queues)."""
+        with self._lock:
+            if self.max_used and self.nb_used >= self.max_used:
+                return None
+            buf = self._free.pop() if self._free else None
+            self.nb_used += 1
+        if buf is None:
+            buf = np.empty(self.shape, self.dtype)
+            self.nb_created += 1
+        d = Data(key, shape=self.shape, dtype=self.dtype)
+        copy = d.attach_copy(0, buf)
+        copy.arena = self
+        return copy
+
+    def release(self, copy: DataCopy) -> None:
+        buf = copy.payload
+        copy.payload = None
+        with self._lock:
+            self.nb_used -= 1
+            if buf is not None and len(self._free) < self.max_cached:
+                self._free.append(buf)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "cached": len(self._free),
+                "used": self.nb_used,
+                "created": self.nb_created,
+            }

@@ -1,0 +1,21 @@
+"""Data collections library (reference L6, ``parsec/data_dist/``)."""
+
+from .matrix import (
+    FULL,
+    LOWER,
+    UPPER,
+    SymTwoDimBlockCyclic,
+    TiledMatrix,
+    TwoDimBlockCyclic,
+    TwoDimTabular,
+)
+
+__all__ = [
+    "FULL",
+    "LOWER",
+    "UPPER",
+    "TiledMatrix",
+    "TwoDimBlockCyclic",
+    "SymTwoDimBlockCyclic",
+    "TwoDimTabular",
+]

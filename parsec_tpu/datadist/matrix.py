@@ -1,0 +1,177 @@
+"""Tiled-matrix descriptors and distributions.
+
+Reference: ``/root/reference/parsec/data_dist/matrix/`` —
+``parsec_tiled_matrix_t`` base descriptor (``matrix.h``: mb/nb tile sizes,
+lm/ln full sizes, mt/nt tile counts, uplo storage) and the workhorse
+ScaLAPACK-style two-dimensional block-cyclic distribution with k-cyclic
+super-tiling (``two_dim_rectangle_cyclic.{c,h}``, init ``:73``; placement:
+row rank = (m / kp) %% P, col rank = (n / kq) %% Q), plus the symmetric
+(lower/upper) variant (``sym_two_dim_rectangle_cyclic.c``) and the tabular
+arbitrary-rank-table distribution (``two_dim_tabular.c``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..data.collection import DataCollection
+from ..data.data import Data, data_create
+
+LOWER = "lower"
+UPPER = "upper"
+FULL = "full"
+
+
+class TiledMatrix(DataCollection):
+    """Base tiled-matrix collection: an ``m×n`` matrix cut into ``mb×nb``
+    tiles (ragged edge tiles allowed), keys are ``(i, j)`` tile indices."""
+
+    def __init__(
+        self,
+        m: int,
+        n: int,
+        mb: int,
+        nb: int,
+        *,
+        name: str = "A",
+        dtype=np.float64,
+        nodes: int = 1,
+        myrank: int = 0,
+        uplo: str = FULL,
+        init: Optional[Callable[[int, int, Tuple[int, int]], np.ndarray]] = None,
+    ):
+        super().__init__(name, nodes=nodes, myrank=myrank)
+        self.m, self.n, self.mb, self.nb = m, n, mb, nb
+        self.mt = (m + mb - 1) // mb
+        self.nt = (n + nb - 1) // nb
+        self.default_dtype = np.dtype(dtype)
+        self.uplo = uplo
+        self._init = init
+        self._store: Dict[Tuple[int, int], Data] = {}
+        self._lock = threading.Lock()
+
+    # -- geometry ---------------------------------------------------------
+    def tile_shape(self, i: int, j: int) -> Tuple[int, int]:
+        return (
+            min(self.mb, self.m - i * self.mb),
+            min(self.nb, self.n - j * self.nb),
+        )
+
+    def stored(self, i: int, j: int) -> bool:
+        if not (0 <= i < self.mt and 0 <= j < self.nt):
+            return False
+        if self.uplo == LOWER:
+            return i >= j
+        if self.uplo == UPPER:
+            return i <= j
+        return True
+
+    def tiles(self):
+        """All stored (i, j) keys."""
+        for i in range(self.mt):
+            for j in range(self.nt):
+                if self.stored(i, j):
+                    yield (i, j)
+
+    def local_tiles(self):
+        for key in self.tiles():
+            if self.rank_of(*key) == self.myrank:
+                yield key
+
+    # -- vtable -----------------------------------------------------------
+    def data_key(self, *key) -> Tuple[int, int]:
+        if len(key) == 1:
+            key = key[0]
+        i, j = key
+        return (int(i), int(j))
+
+    def data_of(self, *key) -> Data:
+        k = self.data_key(*key)
+        if not self.stored(*k):
+            raise KeyError(f"tile {k} not stored in {self.uplo} matrix {self.name}")
+        with self._lock:
+            d = self._store.get(k)
+            if d is None:
+                shape = self.tile_shape(*k)
+                if self._init is not None:
+                    payload = np.asarray(self._init(k[0], k[1], shape), dtype=self.default_dtype)
+                else:
+                    payload = np.zeros(shape, self.default_dtype)
+                d = data_create(k, self, payload=payload)
+                self._store[k] = d
+            return d
+
+    # -- whole-matrix helpers (tests / verification) ----------------------
+    def to_array(self) -> np.ndarray:
+        """Gather the local tiles into a dense array (single-rank use)."""
+        out = np.zeros((self.m, self.n), self.default_dtype)
+        for (i, j) in self.tiles():
+            if self.rank_of(i, j) != self.myrank:
+                continue
+            c = self.data_of(i, j).newest_copy()
+            if c is None:
+                continue
+            h, w = self.tile_shape(i, j)
+            out[i * self.mb : i * self.mb + h, j * self.nb : j * self.nb + w] = np.asarray(c.payload)[:h, :w]
+        return out
+
+    def from_array(self, a: np.ndarray) -> "TiledMatrix":
+        for (i, j) in self.tiles():
+            if self.rank_of(i, j) != self.myrank:
+                continue
+            h, w = self.tile_shape(i, j)
+            tile = np.ascontiguousarray(a[i * self.mb : i * self.mb + h, j * self.nb : j * self.nb + w])
+            d = self.data_of(i, j)
+            copy = d.get_copy(0) or d.attach_copy(0, tile)
+            copy.payload = tile
+        return self
+
+
+class TwoDimBlockCyclic(TiledMatrix):
+    """ScaLAPACK-style 2D block-cyclic placement over a P×Q process grid
+    with kp/kq k-cyclic super-tiling (reference
+    ``two_dim_rectangle_cyclic.h:24-95``)."""
+
+    def __init__(self, m, n, mb, nb, *, p: int = 1, q: int = 1, kp: int = 1, kq: int = 1, **kw):
+        kw.setdefault("nodes", p * q)
+        super().__init__(m, n, mb, nb, **kw)
+        if self.nodes % p != 0 and p * q != self.nodes:
+            raise ValueError(f"grid {p}x{q} incompatible with {self.nodes} nodes")
+        self.p, self.q, self.kp, self.kq = p, q, kp, kq
+
+    def rank_of(self, *key) -> int:
+        i, j = self.data_key(*key)
+        rrow = (i // self.kp) % self.p
+        rcol = (j // self.kq) % self.q
+        return rrow * self.q + rcol
+
+    def vpid_of(self, *key) -> int:
+        return 0
+
+
+class SymTwoDimBlockCyclic(TwoDimBlockCyclic):
+    """Symmetric (triangular-storage) block-cyclic matrix (reference
+    ``sym_two_dim_rectangle_cyclic.c``)."""
+
+    def __init__(self, m, n, mb, nb, *, uplo: str = LOWER, **kw):
+        if uplo not in (LOWER, UPPER):
+            raise ValueError("sym matrix needs uplo lower|upper")
+        super().__init__(m, n, mb, nb, uplo=uplo, **kw)
+
+
+class TwoDimTabular(TiledMatrix):
+    """Arbitrary rank table (reference ``two_dim_tabular.c``): placement
+    comes from a user table or callable over tile keys."""
+
+    def __init__(self, m, n, mb, nb, *, rank_table, **kw):
+        super().__init__(m, n, mb, nb, **kw)
+        self._rank_table = rank_table
+
+    def rank_of(self, *key) -> int:
+        k = self.data_key(*key)
+        if callable(self._rank_table):
+            return int(self._rank_table(*k))
+        return int(self._rank_table[k])

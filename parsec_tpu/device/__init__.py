@@ -2,5 +2,6 @@
 
 from . import device
 from .device import CpuDevice, Device, select_best_device
+from . import tpu  # registers the TPU device component when JAX is present
 
-__all__ = ["device", "Device", "CpuDevice", "select_best_device"]
+__all__ = ["device", "Device", "CpuDevice", "select_best_device", "tpu"]

@@ -1,0 +1,27 @@
+"""DSL front-ends (reference L5): DTD dynamic insertion, PTG builder."""
+
+from .dtd import (
+    AFFINITY,
+    ATOMIC_WRITE,
+    CTL,
+    DONT_TRACK,
+    DTDTaskpool,
+    IN,
+    INOUT,
+    OUT,
+    SCRATCH,
+    VALUE,
+)
+
+__all__ = [
+    "DTDTaskpool",
+    "IN",
+    "OUT",
+    "INOUT",
+    "CTL",
+    "VALUE",
+    "SCRATCH",
+    "ATOMIC_WRITE",
+    "AFFINITY",
+    "DONT_TRACK",
+]

@@ -1,0 +1,85 @@
+"""Data substrate unit tests: coherency protocol, arenas, repos."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.core.lifecycle import AccessMode
+from parsec_tpu.data import Arena, Coherency, DataRepo, data_create
+
+
+def test_create_with_cpu_copy():
+    d = data_create((0, 0), payload=np.ones((4, 4)))
+    c = d.get_copy(0)
+    assert c is not None
+    assert c.coherency == Coherency.EXCLUSIVE
+    assert d.owner_device == 0
+    assert d.shape == (4, 4)
+
+
+def test_reader_demotes_exclusive_to_shared():
+    d = data_create("k", payload=np.zeros(4))
+    c1 = d.transfer_ownership(1, AccessMode.IN)
+    assert c1.coherency == Coherency.SHARED
+    assert d.get_copy(0).coherency == Coherency.SHARED
+
+
+def test_writer_invalidates_other_copies():
+    d = data_create("k", payload=np.zeros(4))
+    d.transfer_ownership(1, AccessMode.IN)
+    c1 = d.transfer_ownership(1, AccessMode.INOUT)
+    assert c1.coherency == Coherency.OWNED
+    assert d.owner_device == 1
+    assert d.get_copy(0).coherency == Coherency.INVALID
+
+
+def test_version_bump_tracks_newest():
+    d = data_create("k", payload=np.zeros(4))
+    d.transfer_ownership(1, AccessMode.OUT)
+    v = d.version_bump(1)
+    assert v == 1
+    assert d.newest_copy().device_index == 1
+    d.transfer_ownership(0, AccessMode.OUT)
+    assert d.version_bump(0) == 2
+    assert d.newest_copy().device_index == 0
+
+
+def test_arena_recycles_buffers():
+    a = Arena((8,), np.float32)
+    c1 = a.allocate("t1")
+    buf1_id = id(c1.payload)
+    a.release(c1)
+    c2 = a.allocate("t2")
+    assert id(c2.payload) == buf1_id  # recycled
+    assert a.stats()["created"] == 1
+
+
+def test_arena_max_used_backpressure():
+    from parsec_tpu.utils import mca_param
+
+    a = Arena((2,), np.float32)
+    a.max_used = 1
+    c1 = a.allocate()
+    assert a.allocate() is None  # backpressure
+    a.release(c1)
+    assert a.allocate() is not None
+
+
+def test_datarepo_usage_counting():
+    r = DataRepo(nb_flows=2)
+    e = r.lookup_and_create("t(3)")
+    e.copies[0] = "copyA"
+    r.set_usage_limit("t(3)", 2)
+    assert len(r) == 1
+    assert r.consume("t(3)").copies[0] == "copyA"
+    assert len(r) == 1
+    r.consume("t(3)")
+    assert len(r) == 0  # reclaimed after last consumer
+
+
+def test_datarepo_consumers_before_producer_limit():
+    r = DataRepo()
+    r.lookup_and_create("k")
+    r.consume("k")
+    r.consume("k")
+    r.set_usage_limit("k", 2)  # producer arrives late
+    assert len(r) == 0

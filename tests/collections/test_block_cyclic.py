@@ -1,0 +1,78 @@
+"""Distribution tests (reference tests/collections kcyclic/band shape)."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.datadist import (
+    LOWER,
+    SymTwoDimBlockCyclic,
+    TiledMatrix,
+    TwoDimBlockCyclic,
+    TwoDimTabular,
+)
+
+
+def test_tile_geometry_ragged_edges():
+    A = TiledMatrix(10, 7, 4, 3)
+    assert (A.mt, A.nt) == (3, 3)
+    assert A.tile_shape(0, 0) == (4, 3)
+    assert A.tile_shape(2, 2) == (2, 1)
+
+
+def test_block_cyclic_rank_formula():
+    A = TwoDimBlockCyclic(16, 16, 2, 2, p=2, q=2)
+    # row rank = i % 2, col rank = j % 2, rank = row*q + col
+    assert A.rank_of(0, 0) == 0
+    assert A.rank_of(0, 1) == 1
+    assert A.rank_of(1, 0) == 2
+    assert A.rank_of(1, 1) == 3
+    assert A.rank_of(2, 2) == 0  # cycles
+
+
+def test_kcyclic_supertiles():
+    A = TwoDimBlockCyclic(32, 32, 2, 2, p=2, q=2, kp=2, kq=2)
+    # with kp=2 consecutive row-pairs map to the same rank row
+    assert A.rank_of(0, 0) == A.rank_of(1, 1) == 0
+    assert A.rank_of(2, 0) == 2
+
+
+def test_rank_partition_is_complete_and_balanced():
+    A = TwoDimBlockCyclic(64, 64, 4, 4, p=2, q=4)
+    counts = {}
+    for key in A.tiles():
+        r = A.rank_of(*key)
+        assert 0 <= r < 8
+        counts[r] = counts.get(r, 0) + 1
+    assert len(counts) == 8
+    assert max(counts.values()) == min(counts.values())  # 16x16 over 2x4
+
+
+def test_roundtrip_array():
+    rng = np.random.default_rng(0)
+    M = rng.standard_normal((12, 12))
+    A = TiledMatrix(12, 12, 5, 5)
+    A.from_array(M)
+    np.testing.assert_allclose(A.to_array(), M)
+
+
+def test_sym_lower_storage():
+    A = SymTwoDimBlockCyclic(8, 8, 2, 2, uplo=LOWER)
+    assert A.stored(3, 1)
+    assert not A.stored(1, 3)
+    with pytest.raises(KeyError):
+        A.data_of(1, 3)
+    assert set(A.tiles()) == {(i, j) for i in range(4) for j in range(4) if i >= j}
+
+
+def test_tabular_distribution():
+    table = {(i, j): (i * 3 + j) % 4 for i in range(3) for j in range(3)}
+    A = TwoDimTabular(6, 6, 2, 2, rank_table=table, nodes=4)
+    assert A.rank_of(1, 1) == table[(1, 1)]
+    B = TwoDimTabular(6, 6, 2, 2, rank_table=lambda i, j: (i + j) % 2, nodes=2)
+    assert B.rank_of(1, 0) == 1
+
+
+def test_local_tiles_filter():
+    A = TwoDimBlockCyclic(8, 8, 2, 2, p=2, q=2, myrank=3)
+    mine = set(A.local_tiles())
+    assert mine == {(i, j) for i in range(4) for j in range(4) if i % 2 == 1 and j % 2 == 1}

@@ -8,11 +8,27 @@ reference's strategy of testing "multi-node" as multi-process on one node,
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault(
-    "XLA_FLAGS",
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8",
-)
+# force the CPU platform: the ambient environment may point JAX at real TPU
+# hardware (JAX_PLATFORMS=axon); unit tests always run on the virtual
+# 8-device CPU mesh. bench.py / examples use the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "true")  # preserve f64 tile dtypes
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+
+# a pytest plugin may import jax before this conftest runs, in which case the
+# env vars above are ignored — set the config directly (safe before the
+# backend is initialized, i.e. before any jax.devices() call)
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:
+    pass  # older jax: covered by XLA_FLAGS above
 
 import pytest  # noqa: E402
 

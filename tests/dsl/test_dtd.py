@@ -1,0 +1,211 @@
+"""DTD front-end tests (reference tests/dsl/dtd/: insertion, war,
+pingpong, simple_gemm shapes)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.dsl import DTDTaskpool, IN, INOUT, OUT, SCRATCH, VALUE, AFFINITY
+from parsec_tpu.datadist import TiledMatrix, TwoDimBlockCyclic
+from parsec_tpu.data import data_create
+
+
+@pytest.fixture
+def ctx():
+    c = Context(nb_cores=4)
+    yield c
+    c.fini()
+
+
+def test_insert_simple_chain(ctx):
+    """RAW chain on one tile must serialize in insertion order."""
+    d = data_create("x", payload=np.zeros(1))
+    tp = DTDTaskpool(ctx)
+    N = 30
+
+    def bump(x):
+        x += 1
+
+    for _ in range(N):
+        tp.insert_task(bump, (d, INOUT))
+    assert tp.wait(timeout=30)
+    assert d.newest_copy().payload[0] == N
+
+
+def test_readers_parallel_writer_serialized(ctx):
+    """WAR: readers between writers all see the writer's value."""
+    d = data_create("x", payload=np.array([7.0]))
+    seen = []
+    lock = threading.Lock()
+    tp = DTDTaskpool(ctx)
+
+    def read(x):
+        with lock:
+            seen.append(float(x[0]))
+
+    def write(x):
+        x[0] = 42.0
+
+    for _ in range(8):
+        tp.insert_task(read, (d, IN))
+    tp.insert_task(write, (d, INOUT))
+    for _ in range(8):
+        tp.insert_task(read, (d, IN))
+    assert tp.wait(timeout=30)
+    assert sorted(seen)[:8] == [7.0] * 8
+    assert sorted(seen)[8:] == [42.0] * 8
+
+
+def test_value_and_scratch_args(ctx):
+    d = data_create("acc", payload=np.zeros(4))
+    tp = DTDTaskpool(ctx)
+
+    def body(out, scratch, k):
+        scratch[:] = k
+        out += scratch
+
+    tp.insert_task(body, (d, INOUT), (((4,), np.float64), SCRATCH), (2.5, VALUE))
+    tp.insert_task(body, (d, INOUT), (((4,), np.float64), SCRATCH), 1.5)  # bare value
+    assert tp.wait(timeout=30)
+    np.testing.assert_allclose(d.newest_copy().payload, 4.0)
+
+
+def test_functional_body_return(ctx):
+    """A body may return replacement outputs instead of mutating."""
+    d = data_create("x", payload=np.ones(3))
+    tp = DTDTaskpool(ctx)
+    tp.insert_task(lambda x: x * 10.0, (d, INOUT))
+    assert tp.wait(timeout=30)
+    np.testing.assert_allclose(d.newest_copy().payload, 10.0)
+
+
+def test_dtd_tiled_gemm(ctx):
+    """The reference's dtd_test_simple_gemm: C = A@B over block-cyclic
+    tiles, verified against numpy."""
+    rng = np.random.default_rng(42)
+    M = N = K = 48
+    nb = 16
+    Adense = rng.standard_normal((M, K))
+    Bdense = rng.standard_normal((K, N))
+    A = TiledMatrix(M, K, nb, nb, name="A").from_array(Adense)
+    B = TiledMatrix(K, N, nb, nb, name="B").from_array(Bdense)
+    C = TwoDimBlockCyclic(M, N, nb, nb, p=1, q=1, name="C")
+
+    tp = DTDTaskpool(ctx)
+
+    def gemm(a, b, c):
+        c += a @ b
+
+    mt, nt, kt = A.mt, B.nt, A.nt
+    for i in range(mt):
+        for j in range(nt):
+            for k in range(kt):
+                tp.insert_task(
+                    gemm,
+                    (A.data_of(i, k), IN),
+                    (B.data_of(k, j), IN),
+                    (C.data_of(i, j), INOUT | AFFINITY),
+                    name="gemm",
+                )
+    tp.flush_all()
+    tp.close()
+    assert ctx.wait(timeout=60)
+    np.testing.assert_allclose(C.to_array(), Adense @ Bdense, rtol=1e-10)
+
+
+def test_out_mode_overwrites(ctx):
+    d = data_create("x", payload=np.array([1.0]))
+    order = []
+    tp = DTDTaskpool(ctx)
+
+    def w1(x):
+        order.append("w1")
+        x[0] = 5.0
+
+    def w2(x):
+        order.append("w2")
+        x[0] = 9.0
+
+    tp.insert_task(w1, (d, OUT))
+    tp.insert_task(w2, (d, OUT))  # WAW serialized
+    assert tp.wait(timeout=30)
+    assert order == ["w1", "w2"]
+    assert d.newest_copy().payload[0] == 9.0
+
+
+def test_window_throttling_bounds_inflight():
+    from parsec_tpu.utils import mca_param
+
+    mca_param.set_param("dtd", "window_size", 32)
+    mca_param.set_param("dtd", "threshold_size", 16)
+    try:
+        with Context(nb_cores=2) as ctx:
+            d = [data_create(i, payload=np.zeros(1)) for i in range(4)]
+            tp = DTDTaskpool(ctx)
+            max_seen = [0]
+
+            def body(x):
+                inflight = tp._inserted - tp._retired
+                max_seen[0] = max(max_seen[0], inflight)
+                x += 1
+
+            for k in range(400):
+                tp.insert_task(body, (d[k % 4], INOUT))
+            assert tp.wait(timeout=60)
+            assert sum(t.newest_copy().payload[0] for t in d) == 400
+            assert max_seen[0] <= 64  # window kept the DAG bounded
+    finally:
+        mca_param.params.unset("dtd", "window_size")
+        mca_param.params.unset("dtd", "threshold_size")
+
+
+def test_insert_after_close_raises(ctx):
+    tp = DTDTaskpool(ctx)
+    d = data_create("x", payload=np.zeros(1))
+    tp.insert_task(lambda x: None, (d, IN))
+    tp.close()
+    with pytest.raises(RuntimeError):
+        tp.insert_task(lambda x: None, (d, IN))
+    assert ctx.wait(timeout=30)
+
+
+def test_raising_body_releases_successors(ctx):
+    """A task whose body raises must still release its successors and count
+    toward quiescence (regression: wait() used to hang)."""
+    d = data_create("x", payload=np.zeros(1))
+    ran = []
+    tp = DTDTaskpool(ctx)
+
+    def boom(x):
+        raise ValueError("kaboom")
+
+    def after(x):
+        ran.append(1)
+        x += 1
+
+    tp.insert_task(boom, (d, INOUT))
+    tp.insert_task(after, (d, INOUT))
+    assert tp.wait(timeout=30)
+    assert ran == [1]
+
+
+def test_wait_zero_timeout_polls(ctx):
+    d = data_create("x", payload=np.zeros(1))
+    tp = DTDTaskpool(ctx)
+    assert tp.wait(timeout=0) is True  # nothing inserted: immediately quiet
+
+
+def test_wait_reopenable(ctx):
+    """wait() quiesces but the pool accepts more tasks after."""
+    d = data_create("x", payload=np.zeros(1))
+    tp = DTDTaskpool(ctx)
+    tp.insert_task(lambda x: x.__iadd__(1), (d, INOUT))
+    assert tp.wait(timeout=30)
+    assert d.newest_copy().payload[0] == 1
+    tp.insert_task(lambda x: x.__iadd__(1), (d, INOUT))
+    assert tp.wait(timeout=30)
+    assert d.newest_copy().payload[0] == 2
+    tp.close()
+    assert ctx.wait(timeout=30)
