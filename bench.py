@@ -1,0 +1,99 @@
+"""Benchmark: tiled Cholesky (dpotrf) through the task runtime on one chip.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "GFLOPS", "vs_baseline": R}
+
+``value`` is the task-runtime dpotrf throughput; ``vs_baseline`` is the
+ratio against a monolithic ``jnp.linalg.cholesky`` of the same matrix on
+the same chip — i.e. what fraction of XLA's own single-kernel performance
+the DAG runtime achieves (1.0 = zero runtime overhead).
+
+Config via env: BENCH_N (matrix size), BENCH_NB (tile size), BENCH_DTYPE.
+Runs on whatever JAX's default backend is (the real TPU chip under the
+driver; CPU elsewhere — sizes shrink automatically off-accelerator).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    # env JAX_PLATFORMS is overridden by this container's TPU sitecustomize;
+    # BENCH_PLATFORM forces the backend in-process (e.g. "cpu" for smoke)
+    forced = os.environ.get("BENCH_PLATFORM")
+    if forced:
+        jax.config.update("jax_platforms", forced)
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
+    N = int(os.environ.get("BENCH_N", "8192" if on_accel else "1024"))
+    NB = int(os.environ.get("BENCH_NB", "1024" if on_accel else "256"))
+    dtype = np.dtype(os.environ.get("BENCH_DTYPE", "float32"))
+
+    rng = np.random.default_rng(0)
+    M = rng.standard_normal((N, N)).astype(dtype)
+    SPD = (M @ M.T + N * np.eye(N, dtype=dtype)).astype(dtype)
+    flops = N**3 / 3.0
+
+    # ---- baseline: monolithic XLA cholesky on the same chip ------------
+    A_dev = jnp.asarray(SPD)
+    chol = jax.jit(jnp.linalg.cholesky)
+    chol(A_dev).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    Lref = chol(A_dev)
+    Lref.block_until_ready()
+    t_mono = time.perf_counter() - t0
+    del Lref
+
+    # ---- task runtime: PTG dpotrf over tiles ---------------------------
+    from parsec_tpu import Context
+    from parsec_tpu.datadist import TiledMatrix
+    from parsec_tpu.ops import cholesky_ptg
+
+    ctx = Context(nb_cores=int(os.environ.get("BENCH_CORES", "4")))
+    use_tpu = on_accel
+
+    def run_once() -> float:
+        A = TiledMatrix(N, N, NB, NB, name="A", dtype=dtype).from_array(SPD)
+        tp = cholesky_ptg(use_tpu=use_tpu, use_cpu=not use_tpu).taskpool(NT=A.mt, A=A)
+        t0 = time.perf_counter()
+        ctx.add_taskpool(tp)
+        ok = tp.wait(timeout=1800)
+        # drain async device work: newest version of the last tile
+        last = A.data_of(A.mt - 1, A.nt - 1).newest_copy()
+        if last is not None and hasattr(last.payload, "block_until_ready"):
+            last.payload.block_until_ready()
+        dt = time.perf_counter() - t0
+        if not ok:
+            raise RuntimeError("dpotrf taskpool did not quiesce")
+        return dt, A
+
+    run_once()  # warmup (jit compiles per kernel shape)
+    t_task, A = run_once()
+
+    # numerics check on a sample tile
+    from parsec_tpu.dsl.dtd import stage_to_cpu
+
+    for key in list(A.tiles())[:: max(1, A.mt)]:
+        stage_to_cpu(A.data_of(*key))
+    ctx.fini()
+
+    gflops = flops / t_task / 1e9
+    mono_gflops = flops / t_mono / 1e9
+    print(json.dumps({
+        "metric": f"dpotrf_tiled_N{N}_nb{NB}_{dtype.name}_{backend}",
+        "value": round(gflops, 2),
+        "unit": "GFLOPS",
+        "vs_baseline": round(gflops / mono_gflops, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""Communication layer (reference L3): CE vtable, backends, remote-dep
+protocol."""
+
+from .engine import (
+    CommEngine,
+    MAX_AM_TAGS,
+    TAG_ACTIVATE,
+    TAG_CTL,
+    TAG_GET,
+    TAG_PUT,
+    TAG_TERMDET,
+)
+from .inproc import InprocComm, InprocFabric
+from .remote_dep import RemoteDepManager
+
+__all__ = [
+    "CommEngine",
+    "InprocComm",
+    "InprocFabric",
+    "RemoteDepManager",
+    "TAG_ACTIVATE",
+    "TAG_GET",
+    "TAG_PUT",
+    "TAG_TERMDET",
+    "TAG_CTL",
+    "MAX_AM_TAGS",
+]

@@ -1,0 +1,93 @@
+"""Communication-engine abstraction (MCA framework ``comm``).
+
+Reference: ``/root/reference/parsec/parsec_comm_engine.{c,h}`` — a
+backend-neutral vtable ``parsec_ce`` with active messages
+(``tag_register``/``send_am``), one-sided ``put``/``get`` on registered
+memory, ``progress``, and capability bits; a fixed tag space of 12 AM tags
+(``parsec_comm_engine.h:24-40``). The reference ships one backend (MPI
+funnelled, single comm thread); here the backends are:
+
+* ``inproc``  — N ranks inside one process (threads + queues), the test
+  fabric (the reference tests "multi-node" as multi-process on one node —
+  same idea one level down);
+* a TCP/DCN backend and an ICI collective path are the planned production
+  transports (see SURVEY.md §5.8).
+
+Payloads are Python objects (tuples + numpy arrays); a wire backend would
+serialize them — the protocol layer (:mod:`.remote_dep`) never assumes
+shared memory except through ``put``/``get``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, TYPE_CHECKING
+
+from ..utils import Component
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..core.context import Context
+
+# AM tag space (reference parsec_comm_engine.h:24-40)
+TAG_ACTIVATE = 0        # dependency activation (remote_dep wire_activate)
+TAG_GET = 1             # payload pull request
+TAG_PUT = 2             # payload push / get answer
+TAG_TERMDET = 3         # termination-detection waves (fourcounter)
+TAG_CTL = 4             # generic control
+TAG_USER_BASE = 5
+MAX_AM_TAGS = 12
+
+
+class CommEngine(Component):
+    """Backend vtable. One instance per rank."""
+
+    mca_type = "comm"
+
+    rank: int = 0
+    nranks: int = 1
+
+    # -- lifecycle ------------------------------------------------------
+    def attach_context(self, context: "Context") -> None:
+        self.context = context
+        from .remote_dep import RemoteDepManager
+
+        self.remote_dep = RemoteDepManager(self)
+
+    def detach_context(self, context: "Context") -> None:
+        pass
+
+    def new_taskpool(self, tp) -> None:
+        """Reference DEP_NEW_TASKPOOL: taskpools register so incoming
+        activations can resolve them (unknown ones are parked)."""
+        rd = getattr(self, "remote_dep", None)
+        if rd is not None:
+            rd.new_taskpool(tp)
+
+    # -- active messages ------------------------------------------------
+    def register_am(self, tag: int, cb: Callable[[int, Any], None]) -> None:
+        """cb(src_rank, payload) runs during ``progress``."""
+        raise NotImplementedError
+
+    def send_am(self, tag: int, dst_rank: int, payload: Any) -> None:
+        raise NotImplementedError
+
+    # -- one-sided ------------------------------------------------------
+    def mem_register(self, handle: Any, buffer: Any) -> None:
+        raise NotImplementedError
+
+    def mem_unregister(self, handle: Any) -> None:
+        raise NotImplementedError
+
+    def get(self, src_rank: int, handle: Any, on_done: Callable[[Any], None]) -> None:
+        """Pull a registered remote buffer; on_done(buffer) fires locally."""
+        raise NotImplementedError
+
+    # -- progress -------------------------------------------------------
+    def progress_nonblocking(self) -> int:
+        """Drain pending incoming messages; returns #messages handled.
+        Driven from worker idle loops (single-node mode of the reference,
+        ``scheduling.c:712-722``) and/or a dedicated comm thread."""
+        raise NotImplementedError
+
+    def barrier(self) -> None:
+        raise NotImplementedError

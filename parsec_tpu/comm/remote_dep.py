@@ -1,0 +1,132 @@
+"""Remote dependency protocol: the dataflow wire logic on top of the CE.
+
+Reference: ``/root/reference/parsec/remote_dep.c`` + ``remote_dep_mpi.c`` —
+a completing task with remote successors emits an *activation* message
+(taskpool, task class, locals, output mask) to each successor rank;
+payloads at or below the short limit travel inline with the activation
+(``remote_dep_mpi.c:1319-1371``); larger ones are pulled by the receiver
+with a one-sided GET against memory the producer registered
+(``wire_get`` / CE put-get handshake). On arrival the receiver deposits the
+data and runs the origin task's ``release_deps`` locally
+(``remote_dep_release_incoming``). Activations for taskpools the receiver
+has not seen yet are parked in a fifo and replayed at taskpool registration
+(``dep_activates_noobj_fifo``, ``remote_dep_mpi.c:102``).
+
+Taskpools are matched across ranks by *name* (every rank instantiates the
+same logical taskpool; numeric ids are process-local).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import debug, mca_param
+from ..data.data import data_create
+from .engine import CommEngine, TAG_ACTIVATE
+
+
+class RemoteDepManager:
+    """Per-rank protocol endpoint bound to a comm engine."""
+
+    def __init__(self, ce: CommEngine):
+        self.ce = ce
+        self.context = None
+        self._taskpools: Dict[str, Any] = {}
+        #: parked activations for unknown taskpools (reference noobj fifo)
+        self._noobj: Dict[str, List[Tuple[int, dict]]] = collections.defaultdict(list)
+        self._lock = threading.Lock()
+        self.short_limit = mca_param.register(
+            "runtime", "comm_short_limit", 1 << 16,
+            help="payloads at or below this inline with activations (bytes)")
+        ce.register_am(TAG_ACTIVATE, self._on_activate)
+        self.stats = collections.Counter()
+
+    # -- taskpool registry ----------------------------------------------
+    def new_taskpool(self, tp) -> None:
+        with self._lock:
+            self._taskpools[tp.name] = tp
+            parked = self._noobj.pop(tp.name, [])
+        for src, msg in parked:
+            self._deliver(tp, src, msg)
+
+    def taskpool_done(self, tp) -> None:
+        with self._lock:
+            self._taskpools.pop(tp.name, None)
+
+    # -- producer side ---------------------------------------------------
+    def send_activation(
+        self,
+        tp,
+        src_class: str,
+        src_locals: Tuple,
+        flow_index: int,
+        payload: Optional[np.ndarray],
+        succ_class: str,
+        succ_locs: Tuple,
+        dst_rank: int,
+    ) -> None:
+        """One successor activation. Inline payloads up to short_limit;
+        larger ones are registered for a one-sided GET."""
+        msg = {
+            "pool": tp.name,
+            "src_class": src_class,
+            "src_locals": src_locals,
+            "flow_index": flow_index,
+            "succ_class": succ_class,
+            "succ_locs": succ_locs,
+        }
+        if payload is None:
+            msg["kind"] = "ctl"
+        elif payload.nbytes <= self.short_limit:
+            msg["kind"] = "inline"
+            msg["data"] = payload
+            self.stats["inline_sent"] += 1
+        else:
+            handle = (tp.name, src_class, src_locals, flow_index)
+            self.ce.mem_register(handle, payload)
+            msg["kind"] = "get"
+            msg["handle"] = handle
+            self.stats["get_advertised"] += 1
+        self.stats["activations_sent"] += 1
+        self.ce.send_am(TAG_ACTIVATE, dst_rank, msg)
+
+    # -- receiver side ---------------------------------------------------
+    def _on_activate(self, src_rank: int, msg: dict) -> None:
+        tp = self._taskpools.get(msg["pool"])
+        if tp is None:
+            with self._lock:
+                tp = self._taskpools.get(msg["pool"])
+                if tp is None:
+                    self._noobj[msg["pool"]].append((src_rank, msg))
+                    self.stats["parked"] += 1
+                    return
+        self._deliver(tp, src_rank, msg)
+
+    def _deliver(self, tp, src_rank: int, msg: dict) -> None:
+        self.stats["activations_recv"] += 1
+        kind = msg["kind"]
+        if kind == "get":
+            self.stats["get_issued"] += 1
+            self.ce.get(
+                src_rank, msg["handle"],
+                lambda buf: self._complete_incoming(tp, msg, buf))
+        elif kind == "inline":
+            self._complete_incoming(tp, msg, msg["data"])
+        else:  # ctl: no data
+            self._complete_incoming(tp, msg, None)
+
+    def _complete_incoming(self, tp, msg: dict, buf: Optional[np.ndarray]) -> None:
+        """Deposit arrived data and release the successor locally
+        (reference remote_dep_release_incoming)."""
+        tp.incoming_remote_release(
+            src_class=msg["src_class"],
+            src_locals=tuple(msg["src_locals"]),
+            flow_index=msg["flow_index"],
+            payload=buf,
+            succ_class=msg["succ_class"],
+            succ_locs=tuple(msg["succ_locs"]),
+        )

@@ -244,16 +244,21 @@ class Context:
 
             traceback.print_exc()
             # run the completion side anyway: successors must be released and
-            # completion callbacks fired or the taskpool never quiesces
+            # completion callbacks fired or the taskpool never quiesces. A
+            # device-manager hook may have ALREADY completed this task before
+            # raising on someone else's behalf — task.retired guards that.
             from .lifecycle import TaskStatus
 
-            if task.status < TaskStatus.PREPARE_OUTPUT:
+            if task.retired:
+                pass
+            elif task.status < TaskStatus.PREPARE_OUTPUT:
                 try:
                     scheduling.complete_execution(self, es, task)
                 except Exception as e2:
                     debug.error("completion of failed task %r also raised: %s", task, e2)
-                    task.taskpool.task_done(task)
-            else:  # raised inside the completion path: just retire
+                    if not task.retired:
+                        task.taskpool.task_done(task)
+            else:  # raised inside this task's completion path: just retire
                 task.taskpool.task_done(task)
 
     def _notify_work(self) -> None:

@@ -87,7 +87,9 @@ def complete_execution(context: "Context", es: Optional["ExecutionStream"], task
     if tc.release_deps is not None:
         pins.fire(pins.RELEASE_DEPS_BEGIN, es, task)
         ready = tc.release_deps(es, task) or ()
-        pins.fire(pins.RELEASE_DEPS_END, es, task)
+        # payload carries (task, released successors): the DOT grapher and
+        # iterator checkers consume the edge list
+        pins.fire(pins.RELEASE_DEPS_END, es, (task, ready))
     if task.on_complete is not None:
         task.on_complete(task)
     if tc.release_task is not None:
@@ -97,6 +99,7 @@ def complete_execution(context: "Context", es: Optional["ExecutionStream"], task
         task.selected_device.sub_load(task.prof.get("est", 0.0))
         task.selected_device.stats["executed_tasks"] += 1
     tp = task.taskpool
+    task.retired = True
     schedule_ready(context, es, ready)
     tp.task_done(task)
 

@@ -135,6 +135,7 @@ class Task:
         "data_in",
         "data_out",
         "repo_entry",
+        "retired",
         "body_args",
         "on_complete",
         "prof",
@@ -164,6 +165,9 @@ class Task:
         #: per-flow output DataCopy
         self.data_out: List[Optional["DataCopy"]] = [None] * len(task_class.flows)
         self.repo_entry = None
+        #: set once complete_execution has retired this task (guards
+        #: against double-retire in error containment paths)
+        self.retired = False
         #: opaque arguments handed to the body hook (DTD arg list, PTG env)
         self.body_args: Any = None
         self.on_complete: Optional[Callable[["Task"], None]] = None

@@ -138,7 +138,7 @@ class TwoDimBlockCyclic(TiledMatrix):
     def __init__(self, m, n, mb, nb, *, p: int = 1, q: int = 1, kp: int = 1, kq: int = 1, **kw):
         kw.setdefault("nodes", p * q)
         super().__init__(m, n, mb, nb, **kw)
-        if self.nodes % p != 0 and p * q != self.nodes:
+        if p * q != self.nodes:
             raise ValueError(f"grid {p}x{q} incompatible with {self.nodes} nodes")
         self.p, self.q, self.kp, self.kq = p, q, kp, kq
 

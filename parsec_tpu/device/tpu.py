@@ -106,7 +106,10 @@ class TpuDevice(Device):
         self.data_index = index
         self.gflops_rating = 100.0  # strongly favour the MXU for eligible tasks
 
-        self._mutex = 0  # reference gpu_device->mutex: >0 ⇒ manager active
+        #: reference gpu_device->mutex collapses to a boolean here: flipped
+        #: under _lock together with the pending-queue append, closing the
+        #: window where two workers could both become manager
+        self._manager_active = False
         self._lock = threading.Lock()
         self._pending: Deque[Task] = collections.deque()
         #: in-order in-flight queues ("compute lanes"); JAX executes one
@@ -130,11 +133,18 @@ class TpuDevice(Device):
         (device_gpu.c:2510-2730)."""
         with self._lock:
             self._pending.append(task)
-            self._mutex += 1
-            if self._mutex > 1:
+            if self._manager_active:
                 return HookReturn.ASYNC  # a manager is already running
+            self._manager_active = True
         # this worker becomes the manager
-        self._manager_loop(es)
+        try:
+            self._manager_loop(es)
+        except BaseException:
+            # let another worker take over the still-queued work instead of
+            # deadlocking every future device task behind a dead manager
+            with self._lock:
+                self._manager_active = False
+            raise
         return HookReturn.ASYNC  # completions were issued by the manager
 
     def _manager_loop(self, es) -> None:
@@ -155,15 +165,11 @@ class TpuDevice(Device):
 
                     traceback.print_exc()
                     scheduling.complete_execution(self.context, es, task)
-                    with self._lock:
-                        self._mutex -= 1
             # phase: get_data_out — retire ready computations in order
             progressed = self._poll_lanes(es)
             with self._lock:
                 if not self._pending and all(not l for l in self._lanes):
-                    if self._mutex != 0:
-                        debug.warning("tpu manager exiting with mutex=%d", self._mutex)
-                        self._mutex = 0
+                    self._manager_active = False
                     return
             if not progressed:
                 # nothing completed this spin: block on the oldest event
@@ -190,8 +196,14 @@ class TpuDevice(Device):
         for pos, spec in enumerate(task.body_args or ()):
             kind, payload, mode = spec
             if kind == "data":
-                arr = self._stage_in(payload)
-                payload.transfer_ownership(self.data_index, mode & AccessMode.INOUT)
+                rw = mode & AccessMode.INOUT
+                if rw == AccessMode.OUT:
+                    # write-only: the body overwrites it — skip the H2D
+                    # transfer (reference skips stage-in for OUT-only flows)
+                    arr = self._out_placeholder(payload)
+                else:
+                    arr = self._stage_in(payload)
+                payload.transfer_ownership(self.data_index, rw)
                 dev_args.append(arr)
                 if mode & AccessMode.OUT:
                     out_specs.append((pos, payload))
@@ -200,6 +212,7 @@ class TpuDevice(Device):
             elif kind == "scratch":
                 shape, dtype = payload
                 dev_args.append(jnp.zeros(shape, dtype))
+            # other kinds (e.g. "ctl") contribute no argument
 
         jitted = self._jit_cache.get(body)
         if jitted is None:
@@ -215,6 +228,15 @@ class TpuDevice(Device):
         lane = self._lanes[self._rr % self._nlanes]
         self._rr += 1
         lane.append(_InFlight(task, outputs, out_specs))
+
+    def _out_placeholder(self, data: Data) -> Any:
+        """Device-side zeros standing in for a write-only tile."""
+        newest = data.newest_copy()
+        shape = data.shape if data.shape is not None else getattr(newest.payload, "shape", None)
+        dtype = data.dtype if data.dtype is not None else getattr(newest.payload, "dtype", None)
+        if shape is None or dtype is None:
+            return self._stage_in(data)  # shape unknown: fall back
+        return jnp.zeros(shape, dtype)
 
     def _stage_in(self, data: Data) -> Any:
         """Materialize the newest version of ``data`` on this device."""
@@ -295,8 +317,6 @@ class TpuDevice(Device):
                 inflight = lane.popleft()
                 self._epilog(inflight)
                 scheduling.complete_execution(self.context, es, inflight.task)
-                with self._lock:
-                    self._mutex -= 1
                 progressed = True
         return progressed
 

@@ -1,5 +1,6 @@
 """DSL front-ends (reference L5): DTD dynamic insertion, PTG builder."""
 
+from .ptg import PTG, PTGTaskClass, PTGTaskpool
 from .dtd import (
     AFFINITY,
     ATOMIC_WRITE,
@@ -14,6 +15,9 @@ from .dtd import (
 )
 
 __all__ = [
+    "PTG",
+    "PTGTaskClass",
+    "PTGTaskpool",
     "DTDTaskpool",
     "IN",
     "OUT",

@@ -187,6 +187,7 @@ class DTDTaskpool(Taskpool):
                 args.append(np.empty(shape, dtype))
             elif kind == "value":
                 args.append(payload)
+            # kind "ctl": dependency only, no body argument
         return args
 
     def _commit_outputs(self, task: Task, args: List[Any], result: Any) -> None:
@@ -248,6 +249,10 @@ class DTDTaskpool(Taskpool):
                 val, mode = a, VALUE
             if mode & AccessMode.SCRATCH:
                 specs.append(("scratch", val, mode))
+            elif mode & AccessMode.CTL and isinstance(val, Data):
+                # control-only dependency on a tile: tracked like a reader,
+                # but contributes no body argument
+                specs.append(("ctl", val, mode))
             elif mode & AccessMode.VALUE or not isinstance(val, Data):
                 specs.append(("value", val, VALUE))
                 mode = VALUE
@@ -272,9 +277,10 @@ class DTDTaskpool(Taskpool):
                 raise NotImplementedError(
                     "multi-rank DTD insertion requires a comm engine backend")
 
-        # dependency inference per tracked data argument
+        # dependency inference per tracked data argument (CTL args track
+        # like readers: they order after the last writer)
         for kind, data, mode in specs:
-            if kind != "data" or (mode & DONT_TRACK):
+            if kind not in ("data", "ctl") or (mode & DONT_TRACK):
                 continue
             st = self._tile_state(data)
             with st.lock:

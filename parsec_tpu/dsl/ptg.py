@@ -1,0 +1,701 @@
+"""PTG — Parameterized Task Graph front-end.
+
+The reference expresses PTG in ``.jdf`` files compiled ahead-of-time to C by
+``parsec_ptgpp`` (``/root/reference/parsec/interfaces/ptg/ptg-compiler/``:
+flex lexer ``parsec.l``, bison grammar ``parsec.y``, codegen ``jdf2c.c``).
+Here the same algebraic model — task classes with integer parameter ranges,
+affinity, guarded dataflow dependencies with task-reference ranges, control
+flows, priorities, multiple body incarnations — is built **at runtime**: the
+"compiler" constructs the task-class vtables (startup enumeration,
+``data_lookup``, ``release_deps``/``iterate_successors``, data resolution
+through per-class usage-counted repos) directly, with dependency
+expressions written as Python expressions in a compact JDF-like syntax:
+
+    ptg = PTG("cholesky")
+    potrf = ptg.task_class("potrf", k="0 .. NT-1")
+    potrf.affinity("A(k, k)")
+    potrf.flow("T", INOUT,
+               "<- (k == 0) ? A(k, k) : T syrk(k, k-1)",
+               "-> T trsm(k+1 .. NT-1, k)",
+               "-> A(k, k)")
+    potrf.body(cpu=potrf_cpu, tpu=potrf_tpu)
+    tp = ptg.taskpool(NT=8, A=A)     # problem-size independent, like JDF
+
+Dependency syntax (reference JDF dependency grammar, ``parsec.y``):
+  ``<-`` input, ``->`` output;
+  optional guard ``(cond) ? TARGET`` or ternary ``(cond) ? T1 : T2``;
+  TARGET is ``FLOW class(args)`` (task reference), ``collection(args)``
+  (memory reference), ``NEW`` (fresh tile), or ``NONE``;
+  an arg may be an inclusive range ``lo .. hi`` (as in JDF) — ranges in
+  output deps broadcast to many successors;
+  a trailing ``[key=value ...]`` property block is accepted (JDF parity)
+  and stashed on the dep;
+  expressions are Python, evaluated over task params + taskpool constants.
+
+Execution model (mirrors SURVEY.md §3.2/§3.3):
+* startup: enumerate the parameter space, schedule every task whose active
+  input deps are all memory references (``jdf2c.c:3036``);
+* ``data_lookup``/prepare_input: inputs resolve to collection tiles or to
+  the producing task's deposited flow data (per-class repo, usage-counted —
+  ``datarepo.c`` semantics);
+* completion: deposit outputs in the repo, enumerate guard-true output task
+  refs (expanding ranges), decrement each successor's counter; successors
+  reaching their goal are constructed and scheduled (counter-mode tracking,
+  ``parsec_internal.h:371-394``).
+
+Symmetry requirement (as in JDF): an input dep ``<- T prod(...)`` must be
+mirrored by the producer's output dep ``-> T cons(...)`` — dependency
+counting and repo deposits are driven from the producer side.
+"""
+
+from __future__ import annotations
+
+import itertools
+import re
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.deps import DepTracker
+from ..core.lifecycle import AccessMode, HookReturn, DEV_CPU, DEV_TPU
+from ..core.task import Chore, Flow, Task, TaskClass
+from ..core.taskpool import Taskpool
+from ..data.data import Data, data_create
+from ..data.datarepo import DataRepo
+
+IN = AccessMode.IN
+OUT = AccessMode.OUT
+INOUT = AccessMode.INOUT
+CTL = AccessMode.CTL
+
+
+# ---------------------------------------------------------------------------
+# expressions
+# ---------------------------------------------------------------------------
+
+_SAFE_BUILTINS = {
+    "min": min, "max": max, "abs": abs, "int": int, "range": range,
+    "len": len, "divmod": divmod, "True": True, "False": False,
+}
+
+
+class _Expr:
+    """A compiled Python expression over task params + constants."""
+
+    __slots__ = ("src", "code")
+
+    def __init__(self, src: str):
+        self.src = src.strip()
+        self.code = compile(self.src, f"<ptg:{self.src}>", "eval")
+
+    def __call__(self, env: Dict[str, Any]) -> Any:
+        return eval(self.code, {"__builtins__": _SAFE_BUILTINS}, env)
+
+    def __repr__(self) -> str:
+        return f"_Expr({self.src!r})"
+
+
+def _split_top(s: str, sep: str) -> List[str]:
+    """Split on ``sep`` at paren/bracket depth 0."""
+    parts: List[str] = []
+    depth, cur, i = 0, [], 0
+    while i < len(s):
+        ch = s[i]
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        if depth == 0 and s.startswith(sep, i):
+            parts.append("".join(cur))
+            cur = []
+            i += len(sep)
+            continue
+        cur.append(ch)
+        i += 1
+    parts.append("".join(cur))
+    return parts
+
+
+class _ArgExpr:
+    """Scalar expression or inclusive range ``lo .. hi``."""
+
+    __slots__ = ("lo", "hi")
+
+    def __init__(self, src: str):
+        parts = _split_top(src, "..")
+        if len(parts) == 1:
+            self.lo, self.hi = _Expr(parts[0]), None
+        elif len(parts) == 2:
+            self.lo, self.hi = _Expr(parts[0]), _Expr(parts[1])
+        else:
+            raise ValueError(f"bad range expression {src!r}")
+
+    def values(self, env: Dict[str, Any]) -> Iterable[int]:
+        if self.hi is None:
+            v = self.lo(env)
+            return v if isinstance(v, range) else (v,)
+        return range(int(self.lo(env)), int(self.hi(env)) + 1)
+
+    def scalar(self, env: Dict[str, Any]) -> Any:
+        if self.hi is not None:
+            raise ValueError(f"range {self.lo.src}..{self.hi.src} used as scalar")
+        return self.lo(env)
+
+
+# ---------------------------------------------------------------------------
+# dependency targets & parsing
+# ---------------------------------------------------------------------------
+
+class _TaskRef:
+    __slots__ = ("flow_name", "class_name", "args")
+
+    def __init__(self, flow_name: str, class_name: str, args: List[_ArgExpr]):
+        self.flow_name, self.class_name, self.args = flow_name, class_name, args
+
+
+class _DataRef:
+    __slots__ = ("collection_name", "args")
+
+    def __init__(self, collection_name: str, args: List[_ArgExpr]):
+        self.collection_name, self.args = collection_name, args
+
+    def key(self, env: Dict[str, Any]) -> Tuple:
+        return tuple(a.scalar(env) for a in self.args)
+
+
+class _NewRef:
+    __slots__ = ()
+
+
+class _NoneRef:
+    __slots__ = ()
+
+
+_TARGET_RE = re.compile(
+    r"^\s*(?:(?P<flow>[A-Za-z_]\w*)\s+)?(?P<name>[A-Za-z_]\w*)\s*\((?P<args>.*)\)\s*$",
+    re.S,
+)
+
+
+def _parse_target(s: str):
+    s = s.strip()
+    if s in ("NEW", "new"):
+        return _NewRef()
+    if s in ("NONE", "NULL", "none"):
+        return _NoneRef()
+    m = _TARGET_RE.match(s)
+    if not m:
+        raise ValueError(f"cannot parse dependency target {s!r}")
+    argsrc = m.group("args").strip()
+    args = [_ArgExpr(a) for a in (_split_top(argsrc, ",") if argsrc else [])]
+    if m.group("flow"):
+        return _TaskRef(m.group("flow"), m.group("name"), args)
+    return _DataRef(m.group("name"), args)
+
+
+class _Dep:
+    """One guarded dependency (reference ``jdf_dep_t``)."""
+
+    __slots__ = ("is_input", "guard", "then", "otherwise", "props")
+
+    def __init__(self, is_input, guard, then, otherwise=None, props=None):
+        self.is_input = is_input
+        self.guard = guard
+        self.then = then
+        self.otherwise = otherwise
+        self.props = props or {}
+
+    def target(self, env: Dict[str, Any]):
+        if self.guard is None:
+            return self.then
+        return self.then if self.guard(env) else self.otherwise
+
+
+def _parse_dep(spec: str) -> _Dep:
+    spec = spec.strip()
+    props: Dict[str, str] = {}
+    pm = re.search(r"\[(.*?)\]\s*$", spec)
+    if pm:
+        for kv in pm.group(1).split():
+            if "=" in kv:
+                k, v = kv.split("=", 1)
+                props[k] = v
+        spec = spec[: pm.start()].strip()
+    if spec.startswith("<-"):
+        is_input, rest = True, spec[2:].strip()
+    elif spec.startswith("->"):
+        is_input, rest = False, spec[2:].strip()
+    else:
+        raise ValueError(f"dependency must start with '<-' or '->': {spec!r}")
+    qparts = _split_top(rest, "?")
+    if len(qparts) == 2:
+        cond = qparts[0].strip()
+        if not (cond.startswith("(") and cond.endswith(")")):
+            raise ValueError(f"guard must be parenthesized: {spec!r}")
+        guard = _Expr(cond[1:-1])
+        branches = _split_top(qparts[1], ":")
+        then = _parse_target(branches[0])
+        otherwise = _parse_target(branches[1]) if len(branches) == 2 else None
+        return _Dep(is_input, guard, then, otherwise, props)
+    if len(qparts) > 2:
+        raise ValueError(f"bad ternary in {spec!r}")
+    return _Dep(is_input, None, _parse_target(rest), None, props)
+
+
+def _expand_args(args: Sequence[_ArgExpr], env: Dict[str, Any]) -> Iterable[Tuple]:
+    pools = [tuple(a.values(env)) for a in args]
+    return itertools.product(*pools)
+
+
+# ---------------------------------------------------------------------------
+# declarations (problem-size independent, like a .jdf file)
+# ---------------------------------------------------------------------------
+
+class _PTGFlow:
+    __slots__ = ("name", "mode", "deps_in", "deps_out", "index")
+
+    def __init__(self, name: str, mode: AccessMode, index: int):
+        self.name, self.mode, self.index = name, mode, index
+        self.deps_in: List[_Dep] = []
+        self.deps_out: List[_Dep] = []
+
+
+class PTGTaskClass:
+    """Declarative task class (reference ``jdf_function_entry_t``)."""
+
+    def __init__(self, ptg: "PTG", name: str, params: Dict[str, str]):
+        self.ptg = ptg
+        self.name = name
+        self.param_names: List[str] = list(params)
+        self.param_ranges: List[_ArgExpr] = [_ArgExpr(v) for v in params.values()]
+        self.flows: List[_PTGFlow] = []
+        self._affinity: Optional[_DataRef] = None
+        self._priority: Optional[_Expr] = None
+        self.bodies: Dict[str, Callable] = {}
+
+    def affinity(self, spec: str) -> "PTGTaskClass":
+        t = _parse_target(spec)
+        if not isinstance(t, _DataRef):
+            raise ValueError("affinity must be a collection reference")
+        self._affinity = t
+        return self
+
+    def priority(self, expr: str) -> "PTGTaskClass":
+        self._priority = _Expr(expr)
+        return self
+
+    def flow(self, name: str, mode: AccessMode, *deps: str) -> "PTGTaskClass":
+        f = _PTGFlow(name, mode, len(self.flows))
+        for d in deps:
+            dep = _parse_dep(d)
+            (f.deps_in if dep.is_input else f.deps_out).append(dep)
+        self.flows.append(f)
+        return self
+
+    def ctl(self, name: str, *deps: str) -> "PTGTaskClass":
+        return self.flow(name, CTL, *deps)
+
+    def body(self, cpu: Optional[Callable] = None, tpu: Optional[Callable] = None,
+             **others: Callable) -> "PTGTaskClass":
+        if cpu is not None:
+            self.bodies[DEV_CPU] = cpu
+        if tpu is not None:
+            self.bodies[DEV_TPU] = tpu
+        self.bodies.update(others)
+        return self
+
+    # -- evaluation over a constants dict --------------------------------
+    def env_of(self, locals_: Tuple, constants: Dict[str, Any]) -> Dict[str, Any]:
+        env = dict(constants)
+        env.update(zip(self.param_names, locals_))
+        return env
+
+    def param_space(self, constants: Dict[str, Any]) -> Iterable[Tuple]:
+        def rec(i: int, acc: Tuple):
+            if i == len(self.param_names):
+                yield acc
+                return
+            env = dict(constants)
+            env.update(zip(self.param_names, acc))
+            for v in self.param_ranges[i].values(env):
+                yield from rec(i + 1, acc + (v,))
+
+        yield from rec(0, ())
+
+    def valid(self, locals_: Tuple, constants: Dict[str, Any]) -> bool:
+        env = dict(constants)
+        for name, rng, v in zip(self.param_names, self.param_ranges, locals_):
+            vals = rng.values(env)
+            if v not in (vals if isinstance(vals, range) else tuple(vals)):
+                return False
+            env[name] = v
+        return True
+
+    def active_input(self, f: _PTGFlow, env: Dict[str, Any]):
+        for dep in f.deps_in:
+            t = dep.target(env)
+            if t is not None and not isinstance(t, _NoneRef):
+                return t
+        return None
+
+    def goal_of(self, locals_: Tuple, constants: Dict[str, Any]) -> int:
+        """Counter-mode dependency goal. Data flows have exactly one active
+        source (guarded alternatives, JDF single-assignment); CTL flows
+        *gather*: every guard-true dep contributes one dependency per
+        instance of its (possibly ranged) task reference (reference
+        controlgather semantics)."""
+        env = self.env_of(locals_, constants)
+        goal = 0
+        for f in self.flows:
+            if f.mode == CTL:
+                for dep in f.deps_in:
+                    t = dep.target(env)
+                    if isinstance(t, _TaskRef):
+                        src_pc = self.ptg.classes[t.class_name]
+                        for locs in _expand_args(t.args, env):
+                            if len(locs) == len(src_pc.param_names) and src_pc.valid(locs, constants):
+                                goal += 1
+            elif isinstance(self.active_input(f, env), _TaskRef):
+                goal += 1
+        return goal
+
+    def rank_of(self, locals_: Tuple, constants: Dict[str, Any]) -> int:
+        if self._affinity is None:
+            return 0
+        env = self.env_of(locals_, constants)
+        dc = constants[self._affinity.collection_name]
+        return dc.rank_of(*self._affinity.key(env))
+
+    def priority_of(self, locals_: Tuple, constants: Dict[str, Any]) -> int:
+        if self._priority is None:
+            return 0
+        return int(self._priority(self.env_of(locals_, constants)))
+
+
+class PTG:
+    """A PTG definition. ``taskpool(**constants)`` instantiates it — the
+    analogue of the generated ``parsec_<name>_new(...)``, reusable with
+    different problem sizes."""
+
+    def __init__(self, name: str, **constants: Any):
+        self.name = name
+        self.constants: Dict[str, Any] = dict(constants)
+        self.classes: Dict[str, PTGTaskClass] = {}
+
+    def task_class(self, name: str, **params: str) -> PTGTaskClass:
+        c = PTGTaskClass(self, name, params)
+        self.classes[name] = c
+        return c
+
+    def taskpool(self, **constants: Any) -> "PTGTaskpool":
+        merged = dict(self.constants)
+        merged.update(constants)
+        return PTGTaskpool(self, merged)
+
+
+# ---------------------------------------------------------------------------
+# the instantiated taskpool (what jdf2c generates)
+# ---------------------------------------------------------------------------
+
+class PTGTaskpool(Taskpool):
+    def __init__(self, ptg: PTG, constants: Dict[str, Any]):
+        super().__init__(name=ptg.name)
+        self.taskpool_type = Taskpool.TYPE_PTG
+        self.ptg = ptg
+        self.constants = constants
+        self.deps = DepTracker()
+        self.repos: Dict[str, DataRepo] = {}
+        self._built: Dict[str, TaskClass] = {}
+        self._local_cache: Dict[str, List[Tuple]] = {}
+        self._new_tiles: Dict[Tuple, Data] = {}
+        self._new_lock = threading.Lock()
+        for pc in ptg.classes.values():
+            self.repos[pc.name] = DataRepo(nb_flows=len(pc.flows))
+            self._build_class(pc)
+        self.startup_hook = self._startup
+        # rank-local task count is known up front for a PTG (reference:
+        # computed by generated code); recomputed at attach for rank != 0
+        self.tdm.taskpool_set_nb_tasks(self, self._count_local(rank=0))
+
+    def _count_local(self, rank: int) -> int:
+        self._local_cache.clear()
+        return sum(len(self._local_space(pc, rank)) for pc in self.ptg.classes.values())
+
+    def attached(self, context) -> None:
+        if context.rank != 0:
+            self.tdm.taskpool_set_nb_tasks(self, self._count_local(context.rank))
+        super().attached(context)
+
+    # -- vtable construction (the jdf2c analogue) ------------------------
+    def _build_class(self, pc: PTGTaskClass) -> None:
+        flows = [Flow(f.name, f.mode, f.index) for f in pc.flows]
+        tc = TaskClass(pc.name, flows=flows, nb_parameters=len(pc.param_names))
+        tc.prepare_input = self._make_prepare_input(pc)
+        tc.release_deps = self._make_release_deps(pc)
+        for dev_type, fn in pc.bodies.items():
+            if dev_type == DEV_CPU:
+                chore = Chore(DEV_CPU, _make_cpu_hook(pc, fn))
+            else:
+                chore = Chore(dev_type, _accel_hook)
+                chore.body_fn = _wrap_device_body(pc, fn)
+            tc.add_chore(chore)
+        self._built[pc.name] = tc
+        self.add_task_class(tc)
+
+    def _local_space(self, pc: PTGTaskClass, rank: Optional[int] = None) -> List[Tuple]:
+        if rank is None:
+            rank = self.context.rank if self.context else 0
+        cached = self._local_cache.get(pc.name)
+        if cached is None:
+            cached = [
+                loc for loc in pc.param_space(self.constants)
+                if pc.rank_of(loc, self.constants) == rank
+            ]
+            self._local_cache[pc.name] = cached
+        return cached
+
+    def _startup(self, context, tp) -> List[Task]:
+        out = []
+        for pc in self.ptg.classes.values():
+            for loc in self._local_space(pc):
+                if pc.goal_of(loc, self.constants) == 0:
+                    out.append(self._make_task(pc, loc))
+        return out
+
+    def _make_task(self, pc: PTGTaskClass, locals_: Tuple) -> Task:
+        return Task(self, self._built[pc.name], locals_,
+                    priority=pc.priority_of(locals_, self.constants))
+
+    # -- data resolution -------------------------------------------------
+    def _make_prepare_input(self, pc: PTGTaskClass):
+        def prepare_input(es, task: Task) -> HookReturn:
+            env = pc.env_of(task.locals, self.constants)
+            specs: List[Tuple[str, Any, AccessMode]] = []
+            for f in pc.flows:
+                if f.mode == CTL:
+                    specs.append(("ctl", None, CTL))
+                    continue
+                target = pc.active_input(f, env)
+                data = self._resolve_input(pc, f, target, env, task)
+                specs.append(("data", data, f.mode))
+                task.data_in[f.index] = data.newest_copy() if data is not None else None
+            for name, v in zip(pc.param_names, task.locals):
+                specs.append(("value", v, AccessMode.VALUE))
+            task.body_args = specs
+            return HookReturn.DONE
+
+        return prepare_input
+
+    def _resolve_input(self, pc: PTGTaskClass, f: _PTGFlow, target, env, task: Task) -> Optional[Data]:
+        if target is None or isinstance(target, _NoneRef):
+            if f.mode & AccessMode.OUT:
+                return self._new_tile(pc, f, task)  # pure output, no source
+            return None
+        if isinstance(target, _NewRef):
+            return self._new_tile(pc, f, task)
+        if isinstance(target, _DataRef):
+            dc = self.constants[target.collection_name]
+            return dc.data_of(*target.key(env))
+        # task reference: producer deposited the flow data in its repo
+        src_pc = self.ptg.classes[target.class_name]
+        key = tuple(a.scalar(env) for a in target.args)
+        entry = self.repos[src_pc.name].consume(key)
+        if entry is None:
+            raise RuntimeError(
+                f"{task!r}: producer {target.class_name}{key} left no repo "
+                f"entry for flow {target.flow_name!r} (asymmetric deps?)")
+        src_flow = next(sf for sf in src_pc.flows if sf.name == target.flow_name)
+        data = entry.copies[src_flow.index]
+        if data is None:
+            raise RuntimeError(
+                f"{task!r}: producer {target.class_name}{key} deposited no "
+                f"data for flow {target.flow_name!r}")
+        return data
+
+    def _new_tile(self, pc: PTGTaskClass, f: _PTGFlow, task: Task) -> Data:
+        key = (pc.name, task.locals, f.name)
+        with self._new_lock:
+            d = self._new_tiles.get(key)
+            if d is None:
+                shape = self.constants.get("TILE_SHAPE", (1,))
+                dtype = self.constants.get("TILE_DTYPE", np.float64)
+                d = data_create(key, payload=np.zeros(shape, dtype))
+                self._new_tiles[key] = d
+            return d
+
+    # -- completion / successor release ----------------------------------
+    def _make_release_deps(self, pc: PTGTaskClass):
+        def release_deps(es, task: Task) -> List[Task]:
+            env = pc.env_of(task.locals, self.constants)
+            repo = self.repos[pc.name]
+            entry = None
+            nb_consumers = 0
+            myrank = self.context.rank if self.context else 0
+            succ_list: List[Tuple[PTGTaskClass, Tuple]] = []
+            remote: List[Tuple[_PTGFlow, Optional[Data], PTGTaskClass, Tuple, int]] = []
+            for f in pc.flows:
+                data = None
+                if f.mode != CTL and task.body_args is not None:
+                    data = task.body_args[f.index][1]
+                for dep in f.deps_out:
+                    t = dep.target(env)
+                    if t is None or isinstance(t, (_NoneRef, _NewRef)):
+                        continue
+                    if isinstance(t, _DataRef):
+                        self._write_back(t, env, data)
+                        continue
+                    succ_pc = self.ptg.classes[t.class_name]
+                    for locs in _expand_args(t.args, env):
+                        if len(locs) != len(succ_pc.param_names):
+                            continue
+                        if not succ_pc.valid(locs, self.constants):
+                            continue
+                        rank = succ_pc.rank_of(locs, self.constants)
+                        if rank != myrank:
+                            remote.append((f, data, succ_pc, locs, rank))
+                            continue
+                        if f.mode != CTL:
+                            if entry is None:
+                                entry = repo.lookup_and_create(task.locals)
+                            entry.copies[f.index] = data
+                            nb_consumers += 1
+                        succ_list.append((succ_pc, locs))
+            if entry is not None:
+                repo.set_usage_limit(task.locals, nb_consumers)
+            # remote successors: activation messages over the comm engine
+            # (reference parsec_remote_dep_activate, SURVEY.md §3.4)
+            for f, data, succ_pc, locs, rank in remote:
+                self._remote_release(pc, task, f, data, succ_pc, locs, rank)
+            ready: List[Task] = []
+            for succ_pc, locs in succ_list:
+                became, _ = self.deps.release_counter(
+                    (succ_pc.name, locs), succ_pc.goal_of(locs, self.constants))
+                if became:
+                    ready.append(self._make_task(succ_pc, locs))
+            return ready
+
+        return release_deps
+
+    def _write_back(self, t: _DataRef, env, data: Optional[Data]) -> None:
+        if data is None:
+            return
+        dc = self.constants[t.collection_name]
+        home = dc.data_of(*t.key(env))
+        if home is data:
+            return  # flow aliases its home tile
+        src = data.newest_copy()
+        if src is None:
+            return
+        dst = home.get_copy(0)
+        buf = np.asarray(src.payload)
+        if dst is None or dst.payload is None:
+            home.attach_copy(0, np.array(buf))
+        else:
+            np.copyto(dst.payload, buf)
+        home.version_bump(0)
+
+    def _remote_release(
+        self,
+        pc: PTGTaskClass,
+        task: Task,
+        f: _PTGFlow,
+        data: Optional[Data],
+        succ_pc: PTGTaskClass,
+        locs: Tuple,
+        dst_rank: int,
+    ) -> None:
+        comm = self.context.comm if self.context else None
+        if comm is None:
+            raise RuntimeError(
+                f"task {task!r} has remote successor {succ_pc.name}{locs} on "
+                f"rank {dst_rank} but the context has no comm engine")
+        payload = None
+        if f.mode != CTL and data is not None:
+            src = data.newest_copy()
+            if src is not None:
+                payload = np.asarray(src.payload)
+        comm.remote_dep.send_activation(
+            self, pc.name, task.locals, f.index, payload,
+            succ_pc.name, locs, dst_rank)
+
+    def incoming_remote_release(
+        self,
+        *,
+        src_class: str,
+        src_locals: Tuple,
+        flow_index: int,
+        payload,
+        succ_class: str,
+        succ_locs: Tuple,
+    ) -> None:
+        """Receiver half of the activation protocol (reference
+        ``remote_dep_release_incoming``): deposit the arrived flow data in
+        the producer's repo and decrement the successor's counter."""
+        if payload is not None:
+            repo = self.repos[src_class]
+            entry = repo.lookup_and_create(src_locals)
+            if entry.copies[flow_index] is None:
+                d = data_create((src_class, src_locals, flow_index), payload=payload)
+                entry.copies[flow_index] = d
+        succ_pc = self.ptg.classes[succ_class]
+        became, _ = self.deps.release_counter(
+            (succ_class, succ_locs), succ_pc.goal_of(succ_locs, self.constants))
+        if became and self.context is not None:
+            t = self._make_task(succ_pc, succ_locs)
+            self.context.schedule([t], es=self.context.current_es())
+
+
+# ---------------------------------------------------------------------------
+# body hooks
+# ---------------------------------------------------------------------------
+
+def _accel_hook(es, task):
+    return task.selected_device.kernel_scheduler(es, task)
+
+
+def _wrap_device_body(pc: PTGTaskClass, fn: Callable):
+    """The device module passes positional args (non-CTL flows, then
+    params); re-map to the uniform keyword signature body(FLOW=..., k=...)."""
+    names = [f.name for f in pc.flows if f.mode != CTL] + pc.param_names
+
+    def wrapped(*pos):
+        return fn(**dict(zip(names, pos)))
+
+    wrapped.__name__ = getattr(fn, "__name__", pc.name)
+    return wrapped
+
+
+def _make_cpu_hook(pc: PTGTaskClass, fn: Callable):
+    def cpu_hook(es, task: Task) -> HookReturn:
+        from .dtd import stage_to_cpu
+
+        kw: Dict[str, Any] = {}
+        writable: List[Data] = []
+        for f in pc.flows:
+            if f.mode == CTL:
+                continue
+            data: Optional[Data] = task.body_args[f.index][1]
+            if data is None:
+                kw[f.name] = None
+                continue
+            arr = stage_to_cpu(data)
+            data.transfer_ownership(0, f.mode & AccessMode.INOUT)
+            kw[f.name] = arr
+            if f.mode & AccessMode.OUT:
+                writable.append(data)
+        kw.update(zip(pc.param_names, task.locals))
+        result = fn(**kw)
+        if result is not None:
+            outs = result if isinstance(result, (tuple, list)) else (result,)
+            if len(outs) != len(writable):
+                raise ValueError(
+                    f"{task!r}: body returned {len(outs)} outputs for "
+                    f"{len(writable)} writable flows")
+            for data, new in zip(writable, outs):
+                data.get_copy(0).payload = np.asarray(new)
+        for data in writable:
+            data.version_bump(0)
+        return HookReturn.DONE
+
+    return cpu_hook

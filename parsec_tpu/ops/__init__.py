@@ -1,0 +1,6 @@
+"""Compute bodies (tile kernels) and flagship taskpools."""
+
+from . import tiles
+from .cholesky import cholesky_ptg, run_cholesky
+
+__all__ = ["tiles", "cholesky_ptg", "run_cholesky"]

@@ -1,0 +1,95 @@
+"""Tiled Cholesky factorization (dpotrf) as a PTG — the flagship taskpool.
+
+The reference runtime's headline dense-linear-algebra consumer is DPLASMA's
+dpotrf over a 2D block-cyclic matrix (north star in BASELINE.md). The
+reference repo itself contains no Cholesky (SURVEY.md §6); this is the
+classic right-looking tiled algorithm expressed in the PTG DSL:
+
+  for k:  potrf(k):      A[k,k]   = chol(A[k,k])
+          trsm(k, m):    A[m,k]   = A[m,k] @ A[k,k]^{-T}          (m > k)
+          syrk(k, m):    A[m,m]  -= A[m,k] @ A[m,k]^T             (m > k)
+          gemm(k, m, n): A[m,n]  -= A[m,k] @ A[n,k]^T         (m > n > k)
+
+Dataflow: each tile's value threads through the update chain as a flow, so
+lookahead across iterations emerges from dependencies alone — the classic
+PTG win over fork-join loops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.lifecycle import AccessMode
+from ..dsl.ptg import PTG
+from . import tiles
+
+IN = AccessMode.IN
+INOUT = AccessMode.INOUT
+
+
+def cholesky_ptg(*, use_tpu: bool = True, use_cpu: bool = True) -> PTG:
+    """Build the dpotrf PTG (instantiate with ``.taskpool(NT=..., A=...)``
+    where ``A`` is a TiledMatrix holding the SPD matrix; the factorization
+    happens in place, lower-triangular)."""
+    ptg = PTG("dpotrf")
+
+    def bodies(cpu, tpu):
+        kw = {}
+        if use_cpu:
+            kw["cpu"] = cpu
+        if use_tpu:
+            kw["tpu"] = tpu
+        return kw
+
+    potrf = ptg.task_class("potrf", k="0 .. NT-1")
+    potrf.affinity("A(k, k)")
+    potrf.priority("(NT - k) * 1000")
+    potrf.flow("T", INOUT,
+               "<- (k == 0) ? A(k, k) : A syrk(k-1, k)",
+               "-> T trsm(k, k+1 .. NT-1)",
+               "-> A(k, k)")
+    potrf.body(**bodies(tiles.potrf_cpu, tiles.potrf_tpu))
+
+    trsm = ptg.task_class("trsm", k="0 .. NT-2", m="k+1 .. NT-1")
+    trsm.affinity("A(m, k)")
+    trsm.priority("(NT - m) * 100")
+    trsm.flow("T", IN,
+              "<- T potrf(k)")
+    trsm.flow("C", INOUT,
+              "<- (k == 0) ? A(m, k) : A gemm(k-1, m, k)",
+              "-> B syrk(k, m)",
+              "-> B1 gemm(k, m, k+1 .. m-1)",
+              "-> B2 gemm(k, m+1 .. NT-1, m)",
+              "-> A(m, k)")
+    trsm.body(**bodies(tiles.trsm_cpu, tiles.trsm_tpu))
+
+    syrk = ptg.task_class("syrk", k="0 .. NT-2", m="k+1 .. NT-1")
+    syrk.affinity("A(m, m)")
+    syrk.priority("(NT - m) * 100 + 10")
+    syrk.flow("A", INOUT,
+              "<- (k == 0) ? A(m, m) : A syrk(k-1, m)",
+              "-> (k == m-1) ? T potrf(m) : A syrk(k+1, m)")
+    syrk.flow("B", IN,
+              "<- C trsm(k, m)")
+    syrk.body(**bodies(tiles.syrk_cpu, tiles.syrk_tpu))
+
+    gemm = ptg.task_class("gemm", k="0 .. NT-3", m="k+2 .. NT-1", n="k+1 .. m-1")
+    gemm.affinity("A(m, n)")
+    gemm.priority("(NT - m) * 10")
+    gemm.flow("A", INOUT,
+              "<- (k == 0) ? A(m, n) : A gemm(k-1, m, n)",
+              "-> (k == n-1) ? C trsm(n, m) : A gemm(k+1, m, n)")
+    gemm.flow("B1", IN, "<- C trsm(k, m)")
+    gemm.flow("B2", IN, "<- C trsm(k, n)")
+    gemm.body(**bodies(tiles.gemm_update_cpu, tiles.gemm_update_tpu))
+
+    return ptg
+
+
+def run_cholesky(context, A, *, use_tpu: bool = True, use_cpu: bool = True) -> None:
+    """Factorize TiledMatrix ``A`` (SPD) in place: A := L (lower)."""
+    tp = cholesky_ptg(use_tpu=use_tpu, use_cpu=use_cpu).taskpool(NT=A.mt, A=A)
+    context.add_taskpool(tp)
+    ok = tp.wait(timeout=None)
+    if not ok:
+        raise RuntimeError("cholesky taskpool did not quiesce")

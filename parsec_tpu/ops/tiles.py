@@ -1,0 +1,71 @@
+"""Tile-level compute bodies for dense linear algebra.
+
+Each op comes in two incarnations, matching the multi-chore model
+(reference: BODY [type=CUDA] blocks, ``tests/runtime/cuda/nvlink.jdf``):
+
+* ``*_cpu`` — numpy, mutates tiles in place (reference CPU BODY semantics);
+* ``*_tpu`` — functional JAX, returns fresh arrays; jit-compiled by the
+  device module and executed on the MXU. bf16/f32 precision is chosen by
+  the tile dtype; matmuls request ``precision="highest"`` to use the f32
+  MXU passes when inputs are f32.
+
+The four Cholesky kernels follow the classic tiled right-looking
+factorization (the reference ecosystem's dpotrf lives in DPLASMA — see
+SURVEY.md §6; re-derived here, not copied).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import jax
+    import jax.numpy as jnp
+    from jax.scipy.linalg import solve_triangular as _jsolve
+except Exception:  # pragma: no cover
+    jax = None
+
+
+# -- GEMM -------------------------------------------------------------------
+
+def gemm_cpu(a, b, c):
+    c += a @ b
+
+
+def gemm_tpu(a, b, c):
+    return c + jnp.dot(a, b, precision="highest")
+
+
+# -- Cholesky kernels (lower, right-looking) --------------------------------
+
+def potrf_cpu(T, **_):
+    T[:] = np.linalg.cholesky(T)
+
+
+def potrf_tpu(T, **_):
+    return jnp.linalg.cholesky(T)
+
+
+def trsm_cpu(T, C, **_):
+    # solve X * T^T = C  for X (T lower-triangular) => X = C * T^{-T}
+    C[:] = np.linalg.solve(np.tril(T), C.T).T
+
+
+def trsm_tpu(T, C, **_):
+    return _jsolve(T, C.T, lower=True, trans=0).T
+
+
+def syrk_cpu(A, B, **_):
+    A -= B @ B.T
+
+
+def syrk_tpu(A, B, **_):
+    return A - jnp.dot(B, B.T, precision="highest")
+
+
+def gemm_update_cpu(A, B1, B2, **_):
+    A -= B1 @ B2.T
+
+
+def gemm_update_tpu(A, B1, B2, **_):
+    return A - jnp.dot(B1, B2.T, precision="highest")

@@ -1,0 +1,20 @@
+"""Multi-chip SPMD layer: meshes, collectives, partitioned kernels.
+
+The TPU-native counterpart of the reference's multi-rank execution — see
+``spmd.py`` for the mapping.
+"""
+
+from .mesh import best_grid, block_sharding, make_mesh, replicated
+from . import collectives
+from .spmd import ring_gemm, spmd_cholesky, summa_gemm
+
+__all__ = [
+    "best_grid",
+    "make_mesh",
+    "block_sharding",
+    "replicated",
+    "collectives",
+    "spmd_cholesky",
+    "summa_gemm",
+    "ring_gemm",
+]

@@ -1,0 +1,52 @@
+"""Device-mesh helpers.
+
+The reference scales across nodes via MPI ranks (``remote_dep_mpi.c``); the
+TPU-native equivalent is a ``jax.sharding.Mesh`` over the pod slice with
+XLA collectives riding ICI. These helpers build meshes whose (p, q) axes
+align with the 2D block-cyclic process grids of the collections layer
+(``datadist.TwoDimBlockCyclic(p=..., q=...)``), so owner-computes placement
+maps 1:1 onto chips.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def best_grid(n: int) -> Tuple[int, int]:
+    """Most-square (p, q) factorization of n, p <= q."""
+    p = int(np.sqrt(n))
+    while n % p:
+        p -= 1
+    return p, n // p
+
+
+def make_mesh(
+    shape: Optional[Tuple[int, int]] = None,
+    *,
+    axes: Tuple[str, str] = ("p", "q"),
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a 2D mesh over the available devices (most-square by default)."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if shape is None:
+        shape = best_grid(len(devs))
+    p, q = shape
+    if p * q > len(devs):
+        raise ValueError(f"mesh {shape} needs {p*q} devices, have {len(devs)}")
+    arr = np.array(devs[: p * q]).reshape(p, q)
+    return Mesh(arr, axes)
+
+
+def block_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard a 2D array in blocks over the (p, q) mesh axes."""
+    return NamedSharding(mesh, P(*mesh.axis_names))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

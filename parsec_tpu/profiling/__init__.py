@@ -1,5 +1,9 @@
-"""Observability layer (reference L7): PINS hooks, trace, DOT grapher."""
+"""Observability layer (reference L7): PINS hooks, trace, DOT grapher,
+live properties dictionary."""
 
 from . import pins
+from .trace import TaskProfiler, Trace
+from .grapher import DotGrapher
+from . import dictionary
 
-__all__ = ["pins"]
+__all__ = ["pins", "Trace", "TaskProfiler", "DotGrapher", "dictionary"]

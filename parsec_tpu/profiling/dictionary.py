@@ -1,0 +1,87 @@
+"""Live runtime-properties dictionary.
+
+Reference: ``/root/reference/parsec/dictionary.{c,h}`` + PAPI-SDE
+(``papi_sde.c``) — internal counters (tasks enabled/retired, scheduler
+queue lengths) registered in a shared dictionary that external monitors
+poll (``tools/aggregator_visu``). Here: a process-local registry of
+callables snapshotted on demand; an aggregator thread can poll
+:func:`snapshot` and stream JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Callable, Dict
+
+_lock = threading.Lock()
+_props: Dict[str, Callable[[], Any]] = {}
+
+
+def register_property(name: str, getter: Callable[[], Any]) -> None:
+    with _lock:
+        _props[name] = getter
+
+
+def unregister_property(name: str) -> None:
+    with _lock:
+        _props.pop(name, None)
+
+
+def snapshot() -> Dict[str, Any]:
+    with _lock:
+        items = list(_props.items())
+    out = {}
+    for name, getter in items:
+        try:
+            out[name] = getter()
+        except Exception:
+            out[name] = None
+    return out
+
+
+def register_context(context, prefix: str = "runtime") -> None:
+    """Expose the standard counters for a context (reference PAPI-SDE set:
+    SCHEDULER::PENDING_TASKS, per-device counts…)."""
+    register_property(f"{prefix}.pending_tasks", context.scheduler.pending_estimate)
+    register_property(
+        f"{prefix}.executed_per_worker",
+        lambda: [es.stats["executed"] for es in context.streams])
+    for dev in context.devices:
+        register_property(f"{prefix}.device.{dev.name}", lambda d=dev: dict(d.stats))
+
+
+class Aggregator:
+    """Polling monitor (reference aggregator_visu, minus the GUI): samples
+    the dictionary at an interval into a list / JSONL file."""
+
+    def __init__(self, interval: float = 0.1, path: str = ""):
+        self.interval = interval
+        self.path = path
+        self.samples = []
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self) -> "Aggregator":
+        def loop():
+            f = open(self.path, "w") if self.path else None
+            try:
+                while not self._stop.is_set():
+                    s = {"t": time.time(), **snapshot()}
+                    self.samples.append(s)
+                    if f:
+                        f.write(json.dumps(s) + "\n")
+                    self._stop.wait(self.interval)
+            finally:
+                if f:
+                    f.close()
+
+        self._thread = threading.Thread(target=loop, daemon=True, name="parsec-aggregator")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -171,6 +171,31 @@ def test_insert_after_close_raises(ctx):
     assert ctx.wait(timeout=30)
 
 
+def test_ctl_arg_orders_without_passing(ctx):
+    """A CTL-flagged tile orders after its last writer but is not passed to
+    the body (regression: used to be staged + passed as an extra arg)."""
+    from parsec_tpu.dsl import CTL
+
+    guard = data_create("guard", payload=np.zeros(1))
+    out = data_create("out", payload=np.zeros(1))
+    order = []
+    tp = DTDTaskpool(ctx)
+
+    def writer(g):
+        order.append("w")
+        g[0] = 1.0
+
+    def reader(x):  # exactly ONE arg: the CTL tile must not appear
+        order.append("r")
+        x[0] = 99.0
+
+    tp.insert_task(writer, (guard, INOUT))
+    tp.insert_task(reader, (out, INOUT), (guard, CTL))
+    assert tp.wait(timeout=30)
+    assert order == ["w", "r"]
+    assert out.newest_copy().payload[0] == 99.0
+
+
 def test_raising_body_releases_successors(ctx):
     """A task whose body raises must still release its successors and count
     toward quiescence (regression: wait() used to hang)."""

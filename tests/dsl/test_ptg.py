@@ -1,0 +1,217 @@
+"""PTG front-end tests (reference tests/dsl/ptg: branching, choice,
+controlgather, startup + Ex02_Chain/Ex04_ChainData shapes)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.dsl.ptg import PTG, IN, INOUT
+from parsec_tpu.datadist import TiledMatrix
+from parsec_tpu.data import LocalCollection
+
+
+@pytest.fixture
+def ctx():
+    c = Context(nb_cores=4)
+    yield c
+    c.fini()
+
+
+def test_chain_data(ctx):
+    """Ex04_ChainData: sequential tasks threading one datum."""
+    log = []
+    lock = threading.Lock()
+    dc = LocalCollection("D", shape=(1,), init=lambda k: np.zeros(1))
+
+    ptg = PTG("chain")
+    step = ptg.task_class("step", k="0 .. N-1")
+    step.affinity("D(0)")
+    step.flow("X", INOUT,
+              "<- (k == 0) ? D(0) : X step(k-1)",
+              "-> (k < N-1) ? X step(k+1) : D(0)")
+
+    def body(X, k):
+        with lock:
+            log.append(k)
+        X += k
+
+    step.body(cpu=body)
+    tp = ptg.taskpool(N=20, D=dc)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=30)
+    assert log == list(range(20))
+    np.testing.assert_allclose(dc.data_of(0).newest_copy().payload, sum(range(20)))
+
+
+def test_fanout_ranges_and_reduction(ctx):
+    """Broadcast via a range output dep, then gather via CTL deps."""
+    hits = []
+    lock = threading.Lock()
+    dc = LocalCollection("D", shape=(4,), init=lambda k: np.full(4, float(k)))
+
+    ptg = PTG("bcast")
+    src = ptg.task_class("src")
+    src.flow("X", INOUT, "<- D(0)", "-> X work(0 .. N-1)")
+    src.body(cpu=lambda X: X.__iadd__(1.0))
+
+    work = ptg.task_class("work", w="0 .. N-1")
+    work.flow("X", IN, "<- X src()")
+    work.ctl("done", "-> c sink()")
+
+    def work_body(X, w):
+        with lock:
+            hits.append((w, float(X[0])))
+
+    work.body(cpu=work_body)
+
+    sink = ptg.task_class("sink")
+    sink.ctl("c", "<- done work(0 .. N-1)")  # control-gather over the range
+    done = []
+    sink.body(cpu=lambda: done.append(1))
+
+    tp = ptg.taskpool(N=6, D=dc)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=30)
+    assert sorted(h[0] for h in hits) == list(range(6))
+    assert all(h[1] == 1.0 for h in hits)  # all saw src's increment
+    assert done == [1]
+
+
+def test_ctl_goal_counting(ctx):
+    """CTL inputs are dependencies: sink must wait for all producers."""
+    order = []
+    lock = threading.Lock()
+    ptg = PTG("ctlchain")
+    a = ptg.task_class("a", i="0 .. 2")
+    a.ctl("go", "-> c b()")
+    def abody(i):
+        with lock:
+            order.append(("a", i))
+    a.body(cpu=abody)
+    b = ptg.task_class("b")
+    b.ctl("c", "<- go a(0 .. 2)")
+    b.body(cpu=lambda: order.append(("b",)))
+    tp = ptg.taskpool()
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=30)
+    assert order[-1] == ("b",)
+    assert len(order) == 4
+
+
+def test_multisize_param_space_and_reuse():
+    """The same PTG instantiates at different sizes (JDF problem-size
+    independence)."""
+    ptg = PTG("resize")
+    t = ptg.task_class("t", k="0 .. N-1")
+    counts = []
+    lock = threading.Lock()
+
+    def body(k):
+        with lock:
+            counts.append(k)
+
+    t.body(cpu=body)
+    for n in (3, 7):
+        counts.clear()
+        with Context(nb_cores=2) as ctx:
+            tp = ptg.taskpool(N=n)
+            ctx.add_taskpool(tp)
+            assert tp.wait(timeout=30)
+        assert sorted(counts) == list(range(n))
+
+
+def test_triangular_space(ctx):
+    """Ranges depending on earlier params (m > k)."""
+    seen = []
+    lock = threading.Lock()
+    ptg = PTG("tri")
+    t = ptg.task_class("t", k="0 .. N-1", m="k+1 .. N-1")
+
+    def body(k, m):
+        with lock:
+            seen.append((k, m))
+
+    t.body(cpu=body)
+    tp = ptg.taskpool(N=5)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=30)
+    assert sorted(seen) == [(k, m) for k in range(5) for m in range(k + 1, 5)]
+
+
+def test_priority_expression(ctx):
+    ptg = PTG("prio")
+    t = ptg.task_class("t", k="0 .. 9")
+    t.priority("100 - k")
+    t.body(cpu=lambda k: None)
+    tp = ptg.taskpool()
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=30)
+
+
+def test_cholesky_cpu(ctx):
+    rng = np.random.default_rng(3)
+    N, nb = 96, 32
+    M = rng.standard_normal((N, N))
+    SPD = M @ M.T + N * np.eye(N)
+    from parsec_tpu.ops import run_cholesky
+
+    A = TiledMatrix(N, N, nb, nb, name="A").from_array(SPD)
+    run_cholesky(ctx, A, use_tpu=False)
+    L = np.tril(A.to_array())
+    np.testing.assert_allclose(L, np.linalg.cholesky(SPD), rtol=1e-8, atol=1e-8)
+
+
+def test_cholesky_tpu_device(ctx):
+    rng = np.random.default_rng(4)
+    N, nb = 64, 32
+    M = rng.standard_normal((N, N))
+    SPD = M @ M.T + N * np.eye(N)
+    from parsec_tpu.ops import run_cholesky
+
+    A = TiledMatrix(N, N, nb, nb, name="A").from_array(SPD)
+    run_cholesky(ctx, A, use_cpu=False)
+    # pull tiles home
+    from parsec_tpu.dsl.dtd import stage_to_cpu
+
+    for key in A.tiles():
+        stage_to_cpu(A.data_of(*key))
+    L = np.tril(A.to_array())
+    np.testing.assert_allclose(L, np.linalg.cholesky(SPD), rtol=1e-8, atol=1e-8)
+
+
+def test_cholesky_mixed_chores(ctx):
+    """Both incarnations available: ETA policy distributes; numerics hold."""
+    rng = np.random.default_rng(5)
+    N, nb = 96, 24
+    M = rng.standard_normal((N, N))
+    SPD = M @ M.T + N * np.eye(N)
+    from parsec_tpu.ops import run_cholesky
+
+    A = TiledMatrix(N, N, nb, nb, name="A").from_array(SPD)
+    run_cholesky(ctx, A)
+    from parsec_tpu.dsl.dtd import stage_to_cpu
+
+    for key in A.tiles():
+        stage_to_cpu(A.data_of(*key))
+    L = np.tril(A.to_array())
+    np.testing.assert_allclose(L, np.linalg.cholesky(SPD), rtol=1e-8, atol=1e-8)
+
+
+def test_asymmetric_deps_detected(ctx):
+    """A consumer claiming a producer that never deposits must error
+    loudly, not deadlock silently."""
+    ptg = PTG("asym")
+    p = ptg.task_class("p")
+    p.flow("X", INOUT, "<- D(0)")  # no output task-ref: deposits nothing
+    p.body(cpu=lambda X: None)
+    c = ptg.task_class("c")
+    c.flow("X", IN, "<- X p()")
+    c.body(cpu=lambda X: None)
+    dc = LocalCollection("D", shape=(1,))
+    tp = ptg.taskpool(D=dc)
+    ctx.add_taskpool(tp)
+    # consumer's goal counts the task-ref input, but producer never releases
+    # it: the pool cannot quiesce -> bounded wait returns False
+    assert tp.wait(timeout=1.0) is False

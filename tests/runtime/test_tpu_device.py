@@ -148,6 +148,21 @@ def test_lru_eviction_under_budget_pressure(ctx):
         np.testing.assert_allclose(stage_to_cpu(t), float(i))
 
 
+def test_out_only_flow_skips_stage_in(ctx):
+    """Write-only tiles must not pay an H2D transfer (regression)."""
+    from parsec_tpu.dsl import OUT
+
+    dev = tpu_dev(ctx)
+    d = data_create("x", payload=np.full(64, -1.0))
+    tp = DTDTaskpool(ctx)
+    tp.insert_task({DEV_TPU: lambda x: x + 3.0}, (d, OUT))
+    assert tp.wait(timeout=60)
+    assert dev.stats["bytes_in"] == 0  # no stage-in for OUT-only
+    from parsec_tpu.dsl.dtd import stage_to_cpu
+
+    np.testing.assert_allclose(stage_to_cpu(d), 3.0)  # zeros placeholder + 3
+
+
 def test_restage_does_not_leak_hbm_accounting(ctx):
     """Alternating CPU/TPU writes re-stage the same tile repeatedly; the
     replaced device copy's bytes must be reclaimed (regression)."""
