@@ -1,0 +1,157 @@
+"""Fourcounter — distributed termination detection for dynamic taskpools.
+
+Reference: ``/root/reference/parsec/mca/termdet/fourcounter/`` — when a
+taskpool's total task count is unknown (DTD, dynamic discovery), local
+counters cannot decide quiescence because activations may still be in
+flight. The classic four-counter algorithm aggregates, over a wave through
+all ranks, the counts of (messages sent, messages received) plus per-rank
+busy state; termination is declared when **two consecutive waves** observe
+all ranks idle and identical, balanced totals (sent == received), proving
+no message was in flight between the waves.
+
+The wave here is coordinated by rank 0 over the CE's TERMDET AM tag
+(reference reserves a dedicated tag, ``parsec_comm_engine.h:35``); replies
+return each rank's ``(busy, sent, received)``. Piggybacking on application
+messages (reference ``termdet.h:153-232``) is approximated by counting at
+the CE boundary via :meth:`note_message_sent` / :meth:`note_message_recv`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..core.termdet import TermDetMonitor
+from ..utils import debug, register_component
+from .engine import CommEngine, TAG_TERMDET
+
+
+@register_component("termdet")
+class TermDetFourCounter(TermDetMonitor):
+    """Per-taskpool monitor; every rank's taskpool installs one, bound to
+    the rank's comm engine via :meth:`bind`."""
+
+    mca_name = "fourcounter"
+    mca_priority = 5  # local wins by default; selected explicitly
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._nb_tasks = 0
+        self._runtime_actions = 0
+        self._ready = False
+        self._terminated = False
+        self._on_termination: Optional[Callable] = None
+        self._tp = None
+        # the four counters
+        self.msgs_sent = 0
+        self.msgs_recv = 0
+        # wave state (rank 0 only)
+        self._wave_id = 0
+        self._wave_replies: Dict[int, Tuple[bool, int, int]] = {}
+        self._last_totals: Optional[Tuple[int, int]] = None
+        self.ce: Optional[CommEngine] = None
+
+    # -- monitor interface ------------------------------------------------
+    def monitor_taskpool(self, tp, on_termination):
+        self._tp = tp
+        self._on_termination = on_termination
+
+    def bind(self, ce: CommEngine) -> "TermDetFourCounter":
+        self.ce = ce
+        ce.register_am(TAG_TERMDET, self._on_am)
+        return self
+
+    def taskpool_ready(self, tp):
+        with self._lock:
+            self._ready = True
+
+    def taskpool_set_nb_tasks(self, tp, n):
+        if getattr(tp, "auto_count", False):
+            tp.auto_count = False
+        with self._lock:
+            self._nb_tasks = n
+
+    def taskpool_addto_nb_tasks(self, tp, delta):
+        with self._lock:
+            self._nb_tasks += delta
+            return self._nb_tasks
+
+    def taskpool_addto_runtime_actions(self, tp, delta):
+        with self._lock:
+            self._runtime_actions += delta
+            return self._runtime_actions
+
+    def is_terminated(self, tp) -> bool:
+        with self._lock:
+            return self._terminated
+
+    # -- message accounting (piggyback stand-in) -------------------------
+    def note_message_sent(self) -> None:
+        with self._lock:
+            self.msgs_sent += 1
+
+    def note_message_recv(self) -> None:
+        with self._lock:
+            self.msgs_recv += 1
+
+    def _local_state(self) -> Tuple[bool, int, int]:
+        with self._lock:
+            busy = (not self._ready) or self._nb_tasks != 0 or self._runtime_actions != 0
+            return busy, self.msgs_sent, self.msgs_recv
+
+    # -- wave protocol ----------------------------------------------------
+    def initiate_wave(self) -> None:
+        """Rank 0 starts a collection wave (driven from idle progress)."""
+        assert self.ce is not None and self.ce.rank == 0
+        with self._lock:
+            if self._terminated:
+                return
+            self._wave_id += 1
+            wid = self._wave_id
+            self._wave_replies = {}
+        busy, s, r = self._local_state()
+        self._wave_replies[0] = (busy, s, r)
+        for dst in range(1, self.ce.nranks):
+            self.ce.send_am(TAG_TERMDET, dst, {"type": "probe", "wave": wid})
+        self._maybe_conclude(wid)
+
+    def _on_am(self, src: int, msg: dict) -> None:
+        t = msg.get("type")
+        if t == "probe":
+            busy, s, r = self._local_state()
+            self.ce.send_am(TAG_TERMDET, src, {
+                "type": "reply", "wave": msg["wave"],
+                "busy": busy, "sent": s, "recv": r, "rank": self.ce.rank})
+        elif t == "reply":
+            with self._lock:
+                if msg["wave"] != self._wave_id:
+                    return  # stale wave
+                self._wave_replies[msg["rank"]] = (msg["busy"], msg["sent"], msg["recv"])
+            self._maybe_conclude(msg["wave"])
+        elif t == "terminate":
+            self._declare()
+
+    def _maybe_conclude(self, wid: int) -> None:
+        with self._lock:
+            if wid != self._wave_id or len(self._wave_replies) < self.ce.nranks:
+                return
+            replies = list(self._wave_replies.values())
+            any_busy = any(b for b, _, _ in replies)
+            tot_sent = sum(s for _, s, _ in replies)
+            tot_recv = sum(r for _, _, r in replies)
+            balanced = (not any_busy) and tot_sent == tot_recv
+            confirmed = balanced and self._last_totals == (tot_sent, tot_recv)
+            self._last_totals = (tot_sent, tot_recv) if balanced else None
+        if confirmed:
+            for dst in range(1, self.ce.nranks):
+                self.ce.send_am(TAG_TERMDET, dst, {"type": "terminate"})
+            self._declare()
+
+    def _declare(self) -> None:
+        fire = False
+        with self._lock:
+            if not self._terminated:
+                self._terminated = True
+                fire = True
+        if fire and self._on_termination is not None and self._tp is not None:
+            self._on_termination(self._tp)

@@ -68,8 +68,32 @@ class Context:
         self.scheduler = open_component("sched", sched_name)
         self.scheduler.install(self)
 
+        # virtual-process map + optional core binding (reference vpmap.c +
+        # bindthread.c; see utils/binding.py)
+        from ..utils.binding import VPMap, available_cores
+
+        vspec = str(mca_param.register(
+            "runtime", "vpmap", "flat",
+            help="vp map: flat | nb:<k> | explicit '0,1;2,3' worker lists"))
+        try:
+            if vspec.startswith("nb:"):
+                k = int(vspec[3:])
+                if k < 1:
+                    raise ValueError("vp count must be >= 1")
+                self.vpmap = VPMap.from_nb_vps(self.nb_workers, k)
+            elif ";" in vspec or "," in vspec:
+                self.vpmap = VPMap.from_spec(vspec)
+            else:
+                self.vpmap = VPMap.flat(self.nb_workers)
+        except ValueError as e:
+            debug.fatal("invalid runtime_vpmap parameter %r: %s", vspec, e)
+        self._bind_threads = mca_param.register(
+            "runtime", "bind_threads", False,
+            help="pin worker threads to cores round-robin")
+        self._cores = available_cores()
+
         self.streams: List[ExecutionStream] = [
-            ExecutionStream(i, self) for i in range(self.nb_workers)
+            ExecutionStream(i, self, vp_id=self.vpmap.vp_of(i)) for i in range(self.nb_workers)
         ]
         for es in self.streams:
             self.scheduler.flow_init(es)
@@ -210,6 +234,10 @@ class Context:
 
     def _worker_main(self, es: ExecutionStream) -> None:
         self._tls.es = es
+        if self._bind_threads:
+            from ..utils.binding import bind_current_thread
+
+            bind_current_thread(self.vpmap.core_for(es.worker_id, self._cores))
         backoff = 1e-6
         while True:
             with self._cv:

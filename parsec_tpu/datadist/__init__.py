@@ -9,6 +9,8 @@ from .matrix import (
     TwoDimBlockCyclic,
     TwoDimTabular,
 )
+from .ops import apply_taskpool, map_operator, reduce_cols, reduce_rows, reduce_taskpool
+from .redistribute import redistribute
 
 __all__ = [
     "FULL",
@@ -18,4 +20,10 @@ __all__ = [
     "TwoDimBlockCyclic",
     "SymTwoDimBlockCyclic",
     "TwoDimTabular",
+    "apply_taskpool",
+    "map_operator",
+    "reduce_taskpool",
+    "reduce_rows",
+    "reduce_cols",
+    "redistribute",
 ]

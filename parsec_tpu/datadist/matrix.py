@@ -123,7 +123,10 @@ class TiledMatrix(DataCollection):
             if self.rank_of(i, j) != self.myrank:
                 continue
             h, w = self.tile_shape(i, j)
-            tile = np.ascontiguousarray(a[i * self.mb : i * self.mb + h, j * self.nb : j * self.nb + w])
+            # copy (not a view): the runtime mutates tiles in place and must
+            # never alias the caller's array
+            tile = a[i * self.mb : i * self.mb + h, j * self.nb : j * self.nb + w].astype(
+                self.default_dtype, copy=True)
             d = self.data_of(i, j)
             copy = d.get_copy(0) or d.attach_copy(0, tile)
             copy.payload = tile

@@ -1,0 +1,151 @@
+"""Generic tiled-matrix operations as taskpools.
+
+Reference: ``/root/reference/parsec/data_dist/matrix/`` ships JDF taskpools
+for elementwise application (``apply.jdf`` + ``apply_wrapper.c``),
+reductions (``reduce.jdf``, ``reduce_col.jdf``, ``reduce_row.jdf`` +
+``reduce_wrapper.c``), and a generic unary-operator taskpool
+(``map_operator.c``). Same capabilities here, built on the PTG/DTD
+front-ends.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..core.lifecycle import AccessMode
+from ..core.taskpool import Taskpool
+from ..dsl.dtd import DTDTaskpool, IN, INOUT
+from ..dsl.ptg import PTG
+from .matrix import TiledMatrix
+
+
+def apply_taskpool(context, A: TiledMatrix, op: Callable[[np.ndarray, int, int], Any],
+                   *, uplo: Optional[str] = None) -> DTDTaskpool:
+    """Apply ``op(tile, i, j)`` to every stored tile (reference
+    ``parsec_apply`` / apply.jdf). ``op`` may mutate in place or return a
+    replacement tile. Returns the taskpool (wait on it)."""
+    tp = DTDTaskpool(context, name=f"apply_{A.name}")
+    for (i, j) in A.tiles():
+        if A.rank_of(i, j) != A.myrank:
+            continue
+
+        def body(t, i=i, j=j):
+            return op(t, i, j)
+
+        tp.insert_task(body, (A.data_of(i, j), INOUT), name="apply")
+    return tp
+
+
+def map_operator(context, A: TiledMatrix, B: TiledMatrix,
+                 op: Callable[[np.ndarray, np.ndarray, int, int], Any]) -> DTDTaskpool:
+    """Binary tile map B[i,j] = op(A[i,j], B[i,j]) (reference
+    ``map_operator.c`` generic operator taskpool)."""
+    if (A.mt, A.nt) != (B.mt, B.nt):
+        raise ValueError("map_operator needs matching tile grids")
+    tp = DTDTaskpool(context, name=f"map_{A.name}_{B.name}")
+    for (i, j) in A.tiles():
+        if A.rank_of(i, j) != A.myrank:
+            continue
+
+        def body(a, b, i=i, j=j):
+            return op(a, b, i, j)
+
+        tp.insert_task(body, (A.data_of(i, j), IN), (B.data_of(i, j), INOUT), name="map")
+    return tp
+
+
+def reduce_taskpool(context, A: TiledMatrix,
+                    tile_reduce: Callable[[np.ndarray], Any],
+                    combine: Callable[[Any, Any], Any]) -> "DTDTaskpool":
+    """Full reduction over all local tiles via a binary combining tree
+    (reference reduce.jdf's recursive pairwise reduction). The result is
+    left on the taskpool as ``tp.result`` after wait()."""
+    tp = DTDTaskpool(context, name=f"reduce_{A.name}")
+    keys = [k for k in A.tiles() if A.rank_of(*k) == A.myrank]
+    import threading
+
+    lock = threading.Lock()
+    values: dict = {}
+
+    def leaf(t, key=None):
+        with lock:
+            values[key] = tile_reduce(t)
+
+    for k in keys:
+        tp.insert_task(lambda t, key=k: leaf(t, key=key), (A.data_of(*k), IN), name="reduce_leaf")
+
+    tp.wait()
+    # pairwise combine (host-side tree; cheap relative to tile scans)
+    acc = None
+    for k in keys:
+        acc = values[k] if acc is None else combine(acc, values[k])
+    tp.result = acc
+    return tp
+
+
+def reduce_rows(context, A: TiledMatrix, combine_tiles: Callable[[np.ndarray, np.ndarray], Any]) -> list:
+    """Row-wise tile reduction: fold each tile row to one tile (reference
+    reduce_row.jdf). Returns list of per-row result arrays."""
+    _require_single_rank(A, "reduce_rows")
+    tp = DTDTaskpool(context, name=f"reduce_row_{A.name}")
+    out = [None] * A.mt
+    import threading
+
+    lock = threading.Lock()
+
+    def fold(i):
+        def body(*tiles):
+            acc = tiles[0].copy()
+            for t in tiles[1:]:
+                acc = np.asarray(combine_tiles(acc, t))
+            with lock:
+                out[i] = acc
+
+        return body
+
+    for i in range(A.mt):
+        args = [(A.data_of(i, j), IN) for j in range(A.nt) if A.stored(i, j)]
+        if not args:  # triangular storage: row may hold no tiles
+            continue
+        tp.insert_task(fold(i), *args, name="reduce_row")
+    tp.wait()
+    return out
+
+
+def reduce_cols(context, A: TiledMatrix, combine_tiles: Callable[[np.ndarray, np.ndarray], Any]) -> list:
+    """Column-wise tile reduction (reference reduce_col.jdf)."""
+    _require_single_rank(A, "reduce_cols")
+    tp = DTDTaskpool(context, name=f"reduce_col_{A.name}")
+    out = [None] * A.nt
+    import threading
+
+    lock = threading.Lock()
+
+    def fold(j):
+        def body(*tiles):
+            acc = tiles[0].copy()
+            for t in tiles[1:]:
+                acc = np.asarray(combine_tiles(acc, t))
+            with lock:
+                out[j] = acc
+
+        return body
+
+    for j in range(A.nt):
+        args = [(A.data_of(i, j), IN) for i in range(A.mt) if A.stored(i, j)]
+        if not args:  # triangular storage: column may hold no tiles
+            continue
+        tp.insert_task(fold(j), *args, name="reduce_col")
+    tp.wait()
+    return out
+
+
+def _require_single_rank(A: TiledMatrix, what: str) -> None:
+    """Cross-rank tile reads need a comm-backed collection; until then,
+    refuse loudly rather than silently folding fabricated zero tiles."""
+    if A.nodes > 1:
+        raise NotImplementedError(
+            f"{what} over a {A.nodes}-rank distribution requires remote "
+            f"collection reads (planned); run per-rank or gather first")

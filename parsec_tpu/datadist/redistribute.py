@@ -1,0 +1,123 @@
+"""Tile-grid → tile-grid redistribution.
+
+Reference: ``/root/reference/parsec/data_dist/matrix/redistribute/`` — a
+PTG copying an m×n window from source matrix S (any tiling/distribution,
+offset (ia, ja)) into target matrix T (any tiling/distribution, offset
+(ib, jb)), with a same-geometry fast path (``redistribute_reshuffle.jdf``)
+and a DTD variant (``redistribute_dtd.c``). This is the reference's "array
+resharding": on TPU the SPMD equivalent is ``jax.device_put`` to a new
+NamedSharding; this taskpool version reshards *tiled host collections*.
+
+Each target tile is one task reading every overlapping source tile —
+pure dataflow, so redistribution overlaps with surrounding taskpools.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..dsl.dtd import DTDTaskpool, IN, INOUT
+from .matrix import TiledMatrix
+
+
+def _overlap_1d(lo: int, hi: int, b: int):
+    """Tiles of size b intersecting global index range [lo, hi)."""
+    first = lo // b
+    last = (hi - 1) // b
+    return range(first, last + 1)
+
+
+def redistribute(
+    context,
+    S: TiledMatrix,
+    T: TiledMatrix,
+    *,
+    m: Optional[int] = None,
+    n: Optional[int] = None,
+    ia: int = 0,
+    ja: int = 0,
+    ib: int = 0,
+    jb: int = 0,
+) -> DTDTaskpool:
+    """Copy ``S[ia:ia+m, ja:ja+n]`` into ``T[ib:ib+m, jb:jb+n]`` as a
+    taskpool (reference ``parsec_redistribute``). Defaults copy the full
+    common window. Returns the taskpool; ``wait()`` it (or compose it)."""
+    m = m if m is not None else min(S.m - ia, T.m - ib)
+    n = n if n is not None else min(S.n - ja, T.n - jb)
+    if m <= 0 or n <= 0:
+        raise ValueError("empty redistribution window")
+    if ia + m > S.m or ja + n > S.n or ib + m > T.m or jb + n > T.n:
+        raise ValueError("window exceeds matrix bounds")
+    if S.nodes > 1 or T.nodes > 1:
+        raise NotImplementedError(
+            "multi-rank redistribution requires remote collection reads "
+            "(planned); single-process redistribution only for now")
+
+    tp = DTDTaskpool(context, name=f"redist_{S.name}_to_{T.name}")
+
+    # fast path: identical tiling and aligned offsets → plain tile-wise
+    # copies, skipping all intersection arithmetic (reference
+    # redistribute_reshuffle.jdf same-geometry specialization)
+    same_geometry = (
+        S.mb == T.mb and S.nb == T.nb
+        and ia % S.mb == 0 and ja % S.nb == 0
+        and ib % T.mb == 0 and jb % T.nb == 0
+        and m % S.mb == 0 and n % S.nb == 0
+    )
+    tp.user = {"fast_path": same_geometry}
+    if same_geometry:
+        di, dj = ia // S.mb, ja // S.nb
+        oi, oj = ib // T.mb, jb // T.nb
+
+        def copy_tile(src, dst):
+            dst[:] = src
+
+        for r in range(m // S.mb):
+            for c in range(n // S.nb):
+                tp.insert_task(
+                    copy_tile,
+                    (S.data_of(di + r, dj + c), IN),
+                    (T.data_of(oi + r, oj + c), INOUT),
+                    name="reshuffle")
+        return tp
+
+    for ti in _overlap_1d(ib, ib + m, T.mb):
+        for tj in _overlap_1d(jb, jb + n, T.nb):
+            # target-tile region clipped to the window, in global T coords
+            th, tw = T.tile_shape(ti, tj)
+            r0 = max(ti * T.mb, ib)
+            r1 = min(ti * T.mb + th, ib + m)
+            c0 = max(tj * T.nb, jb)
+            c1 = min(tj * T.nb + tw, jb + n)
+            if r0 >= r1 or c0 >= c1:
+                continue
+            # corresponding S global coords
+            sr0, sr1 = r0 - ib + ia, r1 - ib + ia
+            sc0, sc1 = c0 - jb + ja, c1 - jb + ja
+            src_tiles = [
+                (si, sj)
+                for si in _overlap_1d(sr0, sr1, S.mb)
+                for sj in _overlap_1d(sc0, sc1, S.nb)
+            ]
+
+            def body(*tiles, ti=ti, tj=tj, r0=r0, r1=r1, c0=c0, c1=c1,
+                     sr0=sr0, sc0=sc0, src_tiles=tuple(src_tiles)):
+                dst = tiles[-1]
+                for (si, sj), src in zip(src_tiles, tiles[:-1]):
+                    # intersection of this source tile with the S window
+                    a0 = max(si * S.mb, sr0)
+                    a1 = min(si * S.mb + src.shape[0], sr0 + (r1 - r0))
+                    b0 = max(sj * S.nb, sc0)
+                    b1 = min(sj * S.nb + src.shape[1], sc0 + (c1 - c0))
+                    if a0 >= a1 or b0 >= b1:
+                        continue
+                    dst[a0 - sr0 + (r0 - ti * T.mb):a1 - sr0 + (r0 - ti * T.mb),
+                        b0 - sc0 + (c0 - tj * T.nb):b1 - sc0 + (c0 - tj * T.nb)] = \
+                        src[a0 - si * S.mb:a1 - si * S.mb, b0 - sj * S.nb:b1 - sj * S.nb]
+
+            args = [(S.data_of(*st), IN) for st in src_tiles]
+            args.append((T.data_of(ti, tj), INOUT))
+            tp.insert_task(body, *args, name="redist")
+    return tp

@@ -196,6 +196,9 @@ class TpuDevice(Device):
         for pos, spec in enumerate(task.body_args or ()):
             kind, payload, mode = spec
             if kind == "data":
+                if payload is None:  # optional (guarded-off) flow
+                    dev_args.append(None)
+                    continue
                 rw = mode & AccessMode.INOUT
                 if rw == AccessMode.OUT:
                     # write-only: the body overwrites it — skip the H2D
@@ -341,7 +344,7 @@ class TpuDevice(Device):
     def resident_data(self, task: Task) -> int:
         total = 0
         for spec in task.body_args or ():
-            if spec[0] != "data":
+            if spec[0] != "data" or spec[1] is None:
                 continue
             c = spec[1].get_copy(self.data_index)
             newest = spec[1].newest_copy()

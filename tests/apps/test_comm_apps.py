@@ -1,0 +1,139 @@
+"""Comm/app micro-benchmarks as self-checking tests (reference
+tests/apps: pingpong/rtt, bandwidth, all2all)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.comm import InprocFabric
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl.ptg import PTG, IN, INOUT
+
+from tests.runtime.test_multirank import run_ranks
+
+
+def test_pingpong_rtt():
+    """T round trips of a small tile between 2 ranks (rtt.jdf shape);
+    verifies integrity and prints the per-hop latency."""
+    nranks, trips = 2, 20
+    t0 = time.perf_counter()
+
+    def build(rank, ctx):
+        dc = LocalCollection("D", shape=(64,), nodes=nranks, myrank=rank,
+                            init=lambda k: np.zeros(64))
+        dc.rank_of = lambda *key: dc.data_key(*key) % nranks
+        ptg = PTG("rtt")
+        hop = ptg.task_class("hop", t="0 .. T-1")
+        hop.affinity("D(t)")  # alternates ranks: t%2
+        hop.flow("X", INOUT,
+                 "<- (t == 0) ? D(0) : X hop(t-1)",
+                 "-> (t < T-1) ? X hop(t+1) : D(t)")
+        hop.body(cpu=lambda X, t: X.__iadd__(1.0))
+        return ptg.taskpool(T=trips, D=dc)
+
+    run_ranks(nranks, build)
+    dt = time.perf_counter() - t0
+    print(f"\npingpong: {trips} hops in {dt*1e3:.1f} ms "
+          f"({dt/trips*1e6:.0f} us/hop incl. runtime)")
+
+
+def test_bandwidth_counts():
+    """Reference bandwidth.jdf + check-comms.py: for F transfers of L
+    bytes, the payload byte count at the CE must be exactly F*L."""
+    nranks, F, L = 2, 10, 32768  # 32KB tiles, below default short limit
+
+    def build(rank, ctx):
+        dc = LocalCollection("D", shape=(L // 8,), nodes=nranks, myrank=rank,
+                            init=lambda k: np.zeros(L // 8))
+        dc.rank_of = lambda *key: dc.data_key(*key) % nranks
+        ptg = PTG("bw")
+        snd = ptg.task_class("snd", f="0 .. F-1")
+        snd.affinity("D(0)")
+        snd.flow("X", INOUT, "<- D(2*f)", "-> X rcv(f)")
+        snd.body(cpu=lambda X, f: None)
+        rcv = ptg.task_class("rcv", f="0 .. F-1")
+        rcv.affinity("D(1)")
+        rcv.flow("X", IN, "<- X snd(f)")
+        rcv.body(cpu=lambda X, f: None)
+        return ptg.taskpool(F=F, D=dc)
+
+    ctxs = run_ranks(nranks, build)
+    ce0 = ctxs[0].comm
+    assert ce0.remote_dep.stats["activations_sent"] == F
+    assert ce0.stats["am_bytes"] == F * L  # exact payload accounting
+
+
+def test_all2all():
+    """Every rank's tile reaches every other rank (all2all.jdf shape)."""
+    nranks = 4
+    got = {r: {} for r in range(nranks)}
+    locks = {r: threading.Lock() for r in range(nranks)}
+
+    def build(rank, ctx):
+        dc = LocalCollection("D", shape=(4,), nodes=nranks, myrank=rank,
+                            init=lambda k: np.full(4, float(k[0] if isinstance(k, tuple) else k)))
+        dc.rank_of = lambda *key: dc.data_key(*key) % nranks
+        ptg = PTG("a2a")
+        src = ptg.task_class("src", i="0 .. NR-1")
+        src.affinity("D(i)")
+        src.flow("X", INOUT, "<- D(i)", "-> X snk(i, 0 .. NR-1)")
+        src.body(cpu=lambda X, i: X.__iadd__(100.0))
+        snk = ptg.task_class("snk", i="0 .. NR-1", j="0 .. NR-1")
+        snk.affinity("D(j)")
+        snk.flow("X", IN, "<- X src(i)")
+
+        def snk_body(X, i, j):
+            with locks[rank]:
+                got[rank][(i, j)] = float(X[0])
+
+        snk.body(cpu=snk_body)
+        return ptg.taskpool(NR=nranks, D=dc)
+
+    run_ranks(nranks, build)
+    for r in range(nranks):
+        mine = {k: v for k, v in got[r].items() if k[1] % nranks == r}
+        assert len(mine) == nranks  # one from each source
+        for (i, j), v in mine.items():
+            assert v == 100.0 + i
+
+
+def test_merge_sort_dtd():
+    """Task-parallel merge sort over chunk tiles (merge_sort app shape),
+    via DTD with a pairwise merge tree."""
+    from parsec_tpu.dsl import DTDTaskpool, INOUT, IN
+    from parsec_tpu.data import data_create
+
+    rng = np.random.default_rng(0)
+    nchunks, chunk = 8, 64
+    raw = rng.standard_normal(nchunks * chunk)
+    tiles = [data_create(i, payload=raw[i * chunk:(i + 1) * chunk].copy())
+             for i in range(nchunks)]
+
+    with Context(nb_cores=4) as ctx:
+        tp = DTDTaskpool(ctx)
+        for t in tiles:
+            tp.insert_task(lambda x: np.sort(x), (t, INOUT), name="sort_leaf")
+        # merge tree: each task merges two sorted runs (all tiles of both
+        # halves), runs doubling per level — tasks at one level of
+        # different runs execute in parallel
+        stride = 1
+        while stride < nchunks:
+            for i in range(0, nchunks, 2 * stride):
+                run = tiles[i:i + 2 * stride]
+
+                def merge_runs(*bufs):
+                    whole = np.concatenate(bufs)
+                    whole.sort(kind="mergesort")
+                    off = 0
+                    for b in bufs:
+                        b[:] = whole[off:off + b.shape[0]]
+                        off += b.shape[0]
+
+                tp.insert_task(merge_runs, *[(t, INOUT) for t in run], name="merge")
+            stride *= 2
+        assert tp.wait(timeout=60)
+    result = np.concatenate([np.asarray(t.newest_copy().payload) for t in tiles])
+    np.testing.assert_allclose(result, np.sort(raw))

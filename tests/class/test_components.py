@@ -71,4 +71,5 @@ def test_sched_components_registered():
     import parsec_tpu.core  # noqa: F401
 
     names = set(component_names("sched"))
-    assert {"lfq", "gd", "ap", "ll", "rnd", "spq"} <= names
+    assert {"lfq", "gd", "ap", "ll", "rnd", "spq",
+            "llp", "ltq", "pbq", "lhq", "ip"} <= names  # the full 11-module roster

@@ -1,0 +1,121 @@
+"""Matrix-op taskpool tests (reference tests/collections reduce +
+redistribute ctest suites)."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.datadist import (
+    TiledMatrix,
+    apply_taskpool,
+    map_operator,
+    redistribute,
+    reduce_cols,
+    reduce_rows,
+    reduce_taskpool,
+)
+
+
+@pytest.fixture
+def ctx():
+    c = Context(nb_cores=4)
+    yield c
+    c.fini()
+
+
+def test_apply_scales_every_tile(ctx):
+    rng = np.random.default_rng(0)
+    M = rng.standard_normal((20, 20))
+    A = TiledMatrix(20, 20, 8, 8).from_array(M)
+    tp = apply_taskpool(ctx, A, lambda t, i, j: t.__imul__(2.0))
+    assert tp.wait(timeout=30)
+    np.testing.assert_allclose(A.to_array(), M * 2)
+
+
+def test_apply_functional_return(ctx):
+    M = np.ones((12, 12))
+    A = TiledMatrix(12, 12, 4, 4).from_array(M)
+    tp = apply_taskpool(ctx, A, lambda t, i, j: t + i + j)
+    assert tp.wait(timeout=30)
+    expect = np.ones((12, 12))
+    for i in range(3):
+        for j in range(3):
+            expect[i * 4:(i + 1) * 4, j * 4:(j + 1) * 4] += i + j
+    np.testing.assert_allclose(A.to_array(), expect)
+
+
+def test_map_operator_binary(ctx):
+    rng = np.random.default_rng(1)
+    Ma, Mb = rng.standard_normal((16, 16)), rng.standard_normal((16, 16))
+    A = TiledMatrix(16, 16, 8, 8).from_array(Ma)
+    B = TiledMatrix(16, 16, 8, 8).from_array(Mb)
+    tp = map_operator(ctx, A, B, lambda a, b, i, j: b + a * 3.0)
+    assert tp.wait(timeout=30)
+    np.testing.assert_allclose(B.to_array(), Mb + 3 * Ma)
+
+
+def test_reduce_full_sum(ctx):
+    rng = np.random.default_rng(2)
+    M = rng.standard_normal((24, 24))
+    A = TiledMatrix(24, 24, 8, 8).from_array(M)
+    tp = reduce_taskpool(ctx, A, tile_reduce=np.sum, combine=lambda a, b: a + b)
+    assert tp.result == pytest.approx(M.sum())
+
+
+def test_reduce_rows_cols(ctx):
+    rng = np.random.default_rng(3)
+    M = rng.standard_normal((12, 12))
+    A = TiledMatrix(12, 12, 4, 4).from_array(M)
+    rows = reduce_rows(ctx, A, lambda a, b: a + b)
+    for i, r in enumerate(rows):
+        np.testing.assert_allclose(
+            r, sum(M[i * 4:(i + 1) * 4, j * 4:(j + 1) * 4] for j in range(3)))
+    cols = reduce_cols(ctx, A, lambda a, b: a + b)
+    for j, c in enumerate(cols):
+        np.testing.assert_allclose(
+            c, sum(M[i * 4:(i + 1) * 4, j * 4:(j + 1) * 4] for i in range(3)))
+
+
+def test_redistribute_same_geometry(ctx):
+    rng = np.random.default_rng(4)
+    M = rng.standard_normal((16, 16))
+    S = TiledMatrix(16, 16, 4, 4, name="S").from_array(M)
+    T = TiledMatrix(16, 16, 4, 4, name="T")
+    tp = redistribute(ctx, S, T)
+    assert tp.wait(timeout=30)
+    assert tp.user["fast_path"] is True
+    np.testing.assert_allclose(T.to_array(), M)
+
+
+def test_redistribute_retile(ctx):
+    """Different tile sizes: 5x5 source tiles -> 4x4 target tiles."""
+    rng = np.random.default_rng(5)
+    M = rng.standard_normal((20, 20))
+    S = TiledMatrix(20, 20, 5, 5, name="S").from_array(M)
+    T = TiledMatrix(20, 20, 4, 4, name="T")
+    tp = redistribute(ctx, S, T)
+    assert tp.wait(timeout=30)
+    np.testing.assert_allclose(T.to_array(), M)
+
+
+def test_redistribute_offset_window(ctx):
+    """Sub-window with unaligned offsets on both sides."""
+    rng = np.random.default_rng(6)
+    M = rng.standard_normal((24, 24))
+    S = TiledMatrix(24, 24, 7, 7, name="S").from_array(M)
+    T = TiledMatrix(30, 30, 6, 6, name="T")
+    tp = redistribute(ctx, S, T, m=10, n=12, ia=3, ja=5, ib=11, jb=7)
+    assert tp.wait(timeout=30)
+    out = T.to_array()
+    np.testing.assert_allclose(out[11:21, 7:19], M[3:13, 5:17])
+    # everything outside the window untouched (zeros)
+    mask = np.ones((30, 30), bool)
+    mask[11:21, 7:19] = False
+    assert np.all(out[mask] == 0)
+
+
+def test_redistribute_bounds_checked(ctx):
+    S = TiledMatrix(8, 8, 4, 4, name="S")
+    T = TiledMatrix(8, 8, 4, 4, name="T")
+    with pytest.raises(ValueError):
+        redistribute(ctx, S, T, m=10, n=2)

@@ -55,7 +55,8 @@ def test_chain_runs_in_order(nb_cores):
     assert log == list(range(50))
 
 
-@pytest.mark.parametrize("sched", ["lfq", "gd", "ap", "ll", "rnd", "spq"])
+@pytest.mark.parametrize(
+    "sched", ["lfq", "gd", "ap", "ll", "rnd", "spq", "llp", "ltq", "pbq", "lhq", "ip"])
 def test_all_schedulers_run_fanout(sched):
     """Diamond: root -> N middles -> sink, counter-mode dep on the sink."""
     n = 64

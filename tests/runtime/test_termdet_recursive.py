@@ -1,0 +1,226 @@
+"""Fourcounter termination detection + recursive taskpools + vpmap/binding."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Chore, Context, DEV_CPU, HookReturn, Task, TaskClass, Taskpool
+from parsec_tpu.comm import InprocFabric, TAG_CTL
+from parsec_tpu.comm.termdet_fourcounter import TermDetFourCounter
+from parsec_tpu.core.recursive import recursive_invoke
+from parsec_tpu.utils.binding import VPMap, available_cores, bind_current_thread
+
+
+class _FakeTp:
+    auto_count = False
+    name = "fake"
+
+
+def test_fourcounter_waves_detect_quiescence():
+    """Protocol-level: 3 ranks exchange messages; termination must be
+    declared only after counts balance and two waves agree."""
+    fabric = InprocFabric(3)
+    ces = fabric.endpoints()
+    mons = [TermDetFourCounter().bind(ces[r]) for r in range(3)]
+    fired = []
+    tps = [_FakeTp() for _ in range(3)]
+    for r, m in enumerate(mons):
+        m.monitor_taskpool(tps[r], lambda tp, r=r: fired.append(r))
+        m.taskpool_set_nb_tasks(tps[r], 1)
+        m.taskpool_ready(tps[r])
+
+    def drain():
+        for ce in ces:
+            ce.progress_nonblocking()
+
+    # all ranks busy: a wave must NOT conclude
+    mons[0].initiate_wave()
+    for _ in range(5):
+        drain()
+    assert not fired
+
+    # rank1 "sends" a message to rank2 (counted), rank2 hasn't received yet
+    mons[1].taskpool_addto_nb_tasks(tps[1], -1)
+    mons[1].note_message_sent()
+    mons[0].taskpool_addto_nb_tasks(tps[0], -1)
+    mons[0].initiate_wave()
+    for _ in range(5):
+        drain()
+    assert not fired  # rank2 busy + counts unbalanced
+
+    # message arrives; rank2 finishes its task
+    mons[2].note_message_recv()
+    mons[2].taskpool_addto_nb_tasks(tps[2], -1)
+    # first balanced wave: records totals, must not yet terminate
+    mons[0].initiate_wave()
+    for _ in range(5):
+        drain()
+    assert not fired
+    # second identical balanced wave: terminate everywhere
+    mons[0].initiate_wave()
+    for _ in range(5):
+        drain()
+    assert sorted(fired) == [0, 1, 2]
+    assert all(m.is_terminated(tp) for m, tp in zip(mons, tps))
+
+
+def test_fourcounter_stale_wave_ignored():
+    fabric = InprocFabric(2)
+    ces = fabric.endpoints()
+    m0 = TermDetFourCounter().bind(ces[0])
+    m1 = TermDetFourCounter().bind(ces[1])
+    fired = []
+    tp0, tp1 = _FakeTp(), _FakeTp()
+    m0.monitor_taskpool(tp0, lambda tp: fired.append(0))
+    m1.monitor_taskpool(tp1, lambda tp: fired.append(1))
+    for m, tp in ((m0, tp0), (m1, tp1)):
+        m.taskpool_set_nb_tasks(tp, 0)
+        m.taskpool_ready(tp)
+    m0.initiate_wave()
+    m0.initiate_wave()  # supersedes the first; replies to wave 1 are stale
+    for _ in range(6):
+        for ce in ces:
+            ce.progress_nonblocking()
+    m0.initiate_wave()  # second balanced wave with same totals
+    for _ in range(6):
+        for ce in ces:
+            ce.progress_nonblocking()
+    assert sorted(set(fired)) == [0, 1]
+
+
+def test_recursive_taskpool_completes_parent():
+    order = []
+    lock = threading.Lock()
+    with Context(nb_cores=2) as ctx:
+        parent = Taskpool("parent", nb_tasks=2)
+
+        def leaf_body(es, task):
+            with lock:
+                order.append(("leaf", task.locals[0]))
+            return HookReturn.DONE
+
+        def spawner_body(es, task):
+            sub = Taskpool("sub", nb_tasks=3)
+            ltc = TaskClass("leaf", chores=[Chore(DEV_CPU, leaf_body)], nb_parameters=1)
+            sub.add_task_class(ltc)
+            sub.startup_hook = lambda c, t: [Task(t, ltc, (i,)) for i in range(3)]
+            return recursive_invoke(es, task, sub)
+
+        def after_body(es, task):
+            with lock:
+                order.append(("after",))
+            return HookReturn.DONE
+
+        spawn_tc = TaskClass("spawn", chores=[Chore(DEV_CPU, spawner_body)])
+        after_tc = TaskClass("after", chores=[Chore(DEV_CPU, after_body)])
+        # after depends on spawn (successor released only at spawn's
+        # completion, i.e. after the nested pool quiesced)
+        spawn_tc.release_deps = lambda es, t: [Task(parent, after_tc)]
+        parent.add_task_class(spawn_tc)
+        parent.add_task_class(after_tc)
+        parent.startup_hook = lambda c, t: [Task(t, spawn_tc)]
+        ctx.add_taskpool(parent)
+        assert ctx.wait(timeout=30)
+    leaves = [o for o in order if o[0] == "leaf"]
+    assert sorted(l[1] for l in leaves) == [0, 1, 2]
+    assert order[-1] == ("after",)  # parent successor ran after nested pool
+
+
+def test_vpmap_partitions():
+    m = VPMap.from_nb_vps(8, 2)
+    assert m.nb_vps() == 2
+    assert m.vp_of(0) == 0 and m.vp_of(1) == 1 and m.vp_of(2) == 0
+    m2 = VPMap.from_spec("0,1;2,3")
+    assert m2.vp_of(3) == 1
+    flat = VPMap.flat(4)
+    assert flat.nb_vps() == 1
+
+
+def test_bind_current_thread_roundtrip():
+    import os
+
+    cores = available_cores()
+    before = os.sched_getaffinity(0)
+    try:
+        assert bind_current_thread(cores[0])
+        assert os.sched_getaffinity(0) == {cores[0]}
+    finally:
+        os.sched_setaffinity(0, before)
+
+
+def test_reduce_triangular_no_crash():
+    """Rows/cols with no stored tiles are skipped (regression)."""
+    from parsec_tpu.datadist import LOWER, SymTwoDimBlockCyclic, reduce_cols
+
+    A = SymTwoDimBlockCyclic(16, 16, 4, 4, uplo=LOWER)
+    with Context(nb_cores=2) as ctx:
+        cols = reduce_cols(ctx, A, lambda a, b: a + b)
+    assert all(c is not None for c in cols)  # every column has a diag tile
+
+
+def test_multirank_matrix_ops_refused():
+    from parsec_tpu.datadist import TwoDimBlockCyclic, redistribute, reduce_rows
+
+    A = TwoDimBlockCyclic(16, 16, 4, 4, p=2, q=2, myrank=0)
+    with Context(nb_cores=1) as ctx:
+        with pytest.raises(NotImplementedError):
+            reduce_rows(ctx, A, lambda a, b: a + b)
+        with pytest.raises(NotImplementedError):
+            redistribute(ctx, A, A)
+
+
+def test_lhq_priority_order():
+    """LHQ must pop highest-priority first within a batch (regression)."""
+    from parsec_tpu.core.sched.more import SchedLHQ
+
+    class _Ctx:
+        nb_workers = 2
+
+    class _T:
+        def __init__(self, p):
+            self.priority = p
+
+    class _ES:
+        worker_id = 0
+
+    s = SchedLHQ()
+    s.install(_Ctx())
+    batch = [_T(1), _T(5), _T(3)]
+    s.schedule(_ES(), batch, distance=0)
+    pops = [s.select(_ES()).priority for _ in range(3)]
+    assert pops == [5, 3, 1]
+
+
+def test_bad_vpmap_param_is_config_error():
+    from parsec_tpu.utils import mca_param
+    from parsec_tpu.utils.debug import FatalError
+
+    for bad in ("nb:0", "nb:x"):
+        mca_param.set_param("runtime", "vpmap", bad)
+        try:
+            with pytest.raises(FatalError):
+                Context(nb_cores=2)
+        finally:
+            mca_param.params.unset("runtime", "vpmap")
+
+
+def test_vpmap_core_blocks():
+    m = VPMap.from_nb_vps(4, 2)  # vp0: workers 0,2; vp1: workers 1,3
+    cores = [0, 1, 2, 3]
+    assert m.core_for(0, cores) in (0, 1)
+    assert m.core_for(2, cores) in (0, 1)
+    assert m.core_for(1, cores) in (2, 3)
+    assert m.core_for(3, cores) in (2, 3)
+
+
+def test_context_vpmap_param():
+    from parsec_tpu.utils import mca_param
+
+    mca_param.set_param("runtime", "vpmap", "nb:2")
+    try:
+        with Context(nb_cores=4) as ctx:
+            assert ctx.vpmap.nb_vps() == 2
+            assert [es.vp_id for es in ctx.streams] == [0, 1, 0, 1]
+    finally:
+        mca_param.params.unset("runtime", "vpmap")
