@@ -137,3 +137,44 @@ class TermDetLocal(TermDetMonitor):
             self._terminated = False
             self._nb_tasks = 0
             self._runtime_actions = 0
+
+
+@register_component("termdet")
+class TermDetUserTrigger(TermDetLocal):
+    """App-driven termination (reference ``termdet/user_trigger``,
+    AM tag reserved at ``parsec_comm_engine.h:36``): the taskpool quiesces
+    only when the application calls :meth:`trigger` — counters are still
+    tracked (so runtime actions drain) but reaching zero does not by itself
+    terminate.  Select with ``Taskpool(termdet="user_trigger")``; the
+    taskpool exposes it as ``tp.tdm.trigger(tp)``."""
+
+    mca_name = "user_trigger"
+    mca_priority = 0  # never auto-selected
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._triggered = False
+
+    def trigger(self, tp) -> None:
+        """The user's termination signal.  On a multi-rank context, rank 0
+        triggers and the signal propagates with the normal activation
+        traffic (here: each rank triggers its own monitor)."""
+        fire = False
+        with self._lock:
+            self._triggered = True
+            fire = self._check_locked()
+        if fire:
+            self._fire()
+
+    def _check_locked(self) -> bool:
+        # trigger means "no more work will be discovered": terminate once
+        # already-known tasks and runtime actions drain
+        if (self._ready and self._triggered and not self._terminated
+                and self._nb_tasks == 0 and self._runtime_actions == 0):
+            self._terminated = True
+            return True
+        return False
+
+    def reset(self) -> None:
+        super().reset()
+        self._triggered = False

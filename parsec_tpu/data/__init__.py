@@ -5,6 +5,7 @@ from .data import Coherency, Data, DataCopy, data_create
 from .arena import Arena
 from .datarepo import DataRepo, RepoEntry
 from .collection import DataCollection, LocalCollection
+from .reshape import DataCopyFuture, ReshapeSpec, get_copy_reshape, materialize
 
 __all__ = [
     "Coherency",
@@ -16,4 +17,8 @@ __all__ = [
     "RepoEntry",
     "DataCollection",
     "LocalCollection",
+    "DataCopyFuture",
+    "ReshapeSpec",
+    "get_copy_reshape",
+    "materialize",
 ]

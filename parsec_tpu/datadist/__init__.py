@@ -8,6 +8,7 @@ from .matrix import (
     TiledMatrix,
     TwoDimBlockCyclic,
     TwoDimTabular,
+    VectorTwoDimCyclic,
 )
 from .ops import apply_taskpool, map_operator, reduce_cols, reduce_rows, reduce_taskpool
 from .redistribute import redistribute
@@ -20,6 +21,7 @@ __all__ = [
     "TwoDimBlockCyclic",
     "SymTwoDimBlockCyclic",
     "TwoDimTabular",
+    "VectorTwoDimCyclic",
     "apply_taskpool",
     "map_operator",
     "reduce_taskpool",

@@ -165,6 +165,36 @@ class SymTwoDimBlockCyclic(TwoDimBlockCyclic):
         super().__init__(m, n, mb, nb, uplo=uplo, **kw)
 
 
+class VectorTwoDimCyclic(TiledMatrix):
+    """Distributed vector: ``m`` elements in ``mb``-sized segments, placed
+    cyclically over the process grid (reference
+    ``vector_two_dim_cyclic.{c,h}``).  Keys are single segment indices
+    ``(i,)``; placement follows the row dimension of a P×Q grid so a vector
+    aligns with the rows of a matching :class:`TwoDimBlockCyclic` matrix."""
+
+    def __init__(self, m, mb, *, p: int = 1, q: int = 1, kp: int = 1, **kw):
+        kw.setdefault("nodes", p * q)
+        super().__init__(m, 1, mb, 1, **kw)
+        if p * q != self.nodes:
+            raise ValueError(f"grid {p}x{q} incompatible with {self.nodes} nodes")
+        self.p, self.q, self.kp = p, q, kp
+
+    def data_key(self, *key) -> Tuple[int, int]:
+        if len(key) == 1 and not isinstance(key[0], tuple):
+            return (int(key[0]), 0)
+        return super().data_key(*key)
+
+    def tile_shape(self, i: int, j: int = 0) -> Tuple[int, int]:
+        return (min(self.mb, self.m - i * self.mb), 1)
+
+    def rank_of(self, *key) -> int:
+        i, _ = self.data_key(*key)
+        return ((i // self.kp) % self.p) * self.q
+
+    def vpid_of(self, *key) -> int:
+        return 0
+
+
 class TwoDimTabular(TiledMatrix):
     """Arbitrary rank table (reference ``two_dim_tabular.c``): placement
     comes from a user table or callable over tile keys."""

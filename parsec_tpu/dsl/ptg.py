@@ -63,6 +63,7 @@ from ..core.task import Chore, Flow, Task, TaskClass
 from ..core.taskpool import Taskpool
 from ..data.data import Data, data_create
 from ..data.datarepo import DataRepo
+from ..data.reshape import ReshapeSpec, get_copy_reshape, materialize
 
 IN = AccessMode.IN
 OUT = AccessMode.OUT
@@ -333,10 +334,15 @@ class PTGTaskClass:
         return True
 
     def active_input(self, f: _PTGFlow, env: Dict[str, Any]):
+        t = self.active_input_dep(f, env)
+        return t[1] if t is not None else None
+
+    def active_input_dep(self, f: _PTGFlow, env: Dict[str, Any]):
+        """The guard-true input dep and its target, or None."""
         for dep in f.deps_in:
             t = dep.target(env)
             if t is not None and not isinstance(t, _NoneRef):
-                return t
+                return dep, t
         return None
 
     def goal_of(self, locals_: Tuple, constants: Dict[str, Any]) -> int:
@@ -476,8 +482,15 @@ class PTGTaskpool(Taskpool):
                 if f.mode == CTL:
                     specs.append(("ctl", None, CTL))
                     continue
-                target = pc.active_input(f, env)
+                dt = pc.active_input_dep(f, env)
+                dep, target = dt if dt is not None else (None, None)
                 data = self._resolve_input(pc, f, target, env, task)
+                if data is not None and dep is not None and dep.props:
+                    # dep-level reshape request (reference
+                    # parsec_get_copy_reshape_from_dep, parsec_reshape.c)
+                    rspec = ReshapeSpec.from_props(dep.props, self.constants)
+                    if rspec is not None:
+                        data = materialize(get_copy_reshape(data, rspec))
                 specs.append(("data", data, f.mode))
                 task.data_in[f.index] = data.newest_copy() if data is not None else None
             for name, v in zip(pc.param_names, task.locals):

@@ -76,3 +76,23 @@ def test_local_tiles_filter():
     A = TwoDimBlockCyclic(8, 8, 2, 2, p=2, q=2, myrank=3)
     mine = set(A.local_tiles())
     assert mine == {(i, j) for i in range(4) for j in range(4) if i % 2 == 1 and j % 2 == 1}
+
+
+def test_vector_two_dim_cyclic_placement():
+    from parsec_tpu.datadist import VectorTwoDimCyclic
+
+    v = VectorTwoDimCyclic(100, 10, p=2, q=2, kp=1, name="V", myrank=0)
+    assert v.mt == 10 and v.nt == 1
+    # segments cycle over grid rows: rank = ((i//kp) % p) * q
+    assert [v.rank_of(i) for i in range(4)] == [0, 2, 0, 2]
+    # aligns with the row placement of a matching block-cyclic matrix
+    from parsec_tpu.datadist import TwoDimBlockCyclic
+
+    A = TwoDimBlockCyclic(100, 100, 10, 10, p=2, q=2, myrank=0)
+    for i in range(10):
+        assert v.rank_of(i) // A.q == A.rank_of(i, 0) // A.q
+    d = v.data_of(3)
+    assert d.newest_copy().payload.shape == (10, 1)
+    # ragged tail
+    v2 = VectorTwoDimCyclic(95, 10, p=2, q=1)
+    assert v2.data_of(9).newest_copy().payload.shape == (5, 1)

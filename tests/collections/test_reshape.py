@@ -1,0 +1,138 @@
+"""Reshape engine tests (reference ``tests/collections/reshape/``:
+``local_input_reshape.jdf`` etc. — flow-level dtype/shape conversion via
+lazy datacopy-future promises)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.data.data import data_create
+from parsec_tpu.data.reshape import (
+    DataCopyFuture,
+    ReshapeSpec,
+    get_copy_reshape,
+    materialize,
+    reshape_cache_clear,
+)
+from parsec_tpu.dsl.ptg import PTG, IN, INOUT
+
+
+@pytest.fixture
+def ctx():
+    c = Context(nb_cores=2)
+    yield c
+    c.fini()
+
+
+@pytest.fixture(autouse=True)
+def _clear_cache():
+    reshape_cache_clear()
+    yield
+    reshape_cache_clear()
+
+
+def test_future_lazy_trigger_once():
+    calls = []
+
+    def trig():
+        calls.append(1)
+        d = data_create("x", payload=np.ones(3))
+        return d.get_copy(0)
+
+    f = DataCopyFuture(trig)
+    assert not f.is_ready()
+    got = []
+    f.on_ready(lambda c: got.append(c))
+    c1 = f.get()
+    c2 = f.get()
+    assert c1 is c2 and calls == [1] and got == [c1]
+
+
+def test_future_threads_race_single_resolution():
+    ev = threading.Event()
+
+    def trig():
+        ev.wait(1)
+        d = data_create("y", payload=np.zeros(2))
+        return d.get_copy(0)
+
+    f = DataCopyFuture(trig)
+    results = []
+    ts = [threading.Thread(target=lambda: results.append(f.get(5))) for _ in range(4)]
+    for t in ts:
+        t.start()
+    ev.set()
+    for t in ts:
+        t.join()
+    assert len(set(map(id, results))) == 1
+
+
+def test_reshape_fast_path_no_conversion():
+    d = data_create("a", payload=np.ones((4, 4), np.float32))
+    spec = ReshapeSpec(dtype=np.float32, shape=(4, 4))
+    assert get_copy_reshape(d, spec) is d
+
+
+def test_reshape_lazy_dtype_and_shape():
+    d = data_create("b", payload=np.arange(8, dtype=np.float64))
+    spec = ReshapeSpec(dtype=np.float32, shape=(2, 4))
+    r = get_copy_reshape(d, spec)
+    assert r is not d
+    assert r.newest_copy() is None  # not materialised yet
+    materialize(r)
+    out = r.newest_copy().payload
+    assert out.dtype == np.float32 and out.shape == (2, 4)
+    np.testing.assert_allclose(out.ravel(), np.arange(8))
+    # shared promise: same spec → same reshaped Data
+    assert get_copy_reshape(d, ReshapeSpec(dtype="float32", shape=(2, 4))) is r
+
+
+def test_ptg_input_dep_reshape(ctx):
+    """A consumer's input dep carries [dtype=...]: it sees the converted
+    tile while the producer's deposit keeps its own dtype (reference
+    local_input_reshape.jdf)."""
+    seen = {}
+    dc = LocalCollection("D", shape=(4,), init=lambda k: np.arange(4, dtype=np.float64))
+
+    ptg = PTG("reshape")
+    prod = ptg.task_class("prod")
+    prod.flow("X", INOUT, "<- D(0)", "-> X cons()")
+    prod.body(cpu=lambda X: X.__iadd__(1.0))
+
+    cons = ptg.task_class("cons")
+    cons.flow("X", IN, "<- X prod() [dtype=float32]")
+
+    def cbody(X):
+        seen["dtype"] = X.dtype
+        seen["val"] = np.array(X)
+
+    cons.body(cpu=cbody)
+    tp = ptg.taskpool(D=dc)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=30)
+    assert seen["dtype"] == np.float32
+    np.testing.assert_allclose(seen["val"], np.arange(4) + 1.0)
+    # the home tile keeps the producer's dtype
+    assert dc.data_of(0).newest_copy().payload.dtype == np.float64
+
+
+def test_ptg_type_prop_from_constants(ctx):
+    """[type=NAME] resolves through taskpool constants (reference arena
+    datatype registry)."""
+    seen = {}
+    dc = LocalCollection("D", shape=(6,), init=lambda k: np.ones(6))
+
+    ptg = PTG("typed")
+    a = ptg.task_class("a")
+    a.flow("X", INOUT, "<- D(0)", "-> X b()")
+    a.body(cpu=lambda X: None)
+    b = ptg.task_class("b")
+    b.flow("X", IN, "<- X a() [type=HALF]")
+    b.body(cpu=lambda X: seen.update(dtype=X.dtype, shape=X.shape))
+    tp = ptg.taskpool(D=dc, HALF=ReshapeSpec(dtype=np.float32, shape=(2, 3)))
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=30)
+    assert seen["dtype"] == np.float32 and seen["shape"] == (2, 3)

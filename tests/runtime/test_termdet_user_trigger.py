@@ -1,0 +1,58 @@
+"""user_trigger termination detection (reference
+``parsec/mca/termdet/user_trigger``)."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.core.taskpool import Taskpool
+from parsec_tpu.core.task import Chore, Task, TaskClass
+from parsec_tpu.core.lifecycle import HookReturn, DEV_CPU
+
+
+@pytest.fixture
+def ctx():
+    c = Context(nb_cores=2)
+    yield c
+    c.fini()
+
+
+def test_user_trigger_holds_until_triggered(ctx):
+    done = []
+    tp = Taskpool("ut", termdet="user_trigger")
+    tc = TaskClass("noop", chores=[Chore(DEV_CPU, lambda es, t: HookReturn.DONE)])
+    tc.release_deps = lambda es, t: []
+    tp.add_task_class(tc)
+    tp.on_complete = lambda _tp: done.append(True)
+    tp.startup_hook = lambda c, _tp: [Task(_tp, tc, (i,)) for i in range(8)]
+    ctx.add_taskpool(tp)
+    # tasks retire, but the pool must NOT terminate before the trigger
+    assert not tp.wait(timeout=0.3)
+    assert not done
+    tp.tdm.trigger(tp)
+    assert tp.wait(timeout=10)
+    assert done == [True]
+
+
+def test_user_trigger_waits_for_task_drain(ctx):
+    """Trigger before tasks finish: termination still waits for the drain.
+    (``is_done`` polled directly — a participating ``wait`` would have the
+    master join the work loop and block inside the slow hooks.)"""
+    import time
+
+    tp = Taskpool("ut2", termdet="user_trigger")
+
+    def hook(es, t):
+        time.sleep(0.4)
+        return HookReturn.DONE
+
+    tc = TaskClass("slow", chores=[Chore(DEV_CPU, hook)])
+    tc.release_deps = lambda es, t: []
+    tp.add_task_class(tc)
+    tp.startup_hook = lambda c, _tp: [Task(_tp, tc, (i,)) for i in range(2)]
+    ctx.add_taskpool(tp)
+    ctx.start()
+    time.sleep(0.05)  # hooks are running on the workers now
+    tp.tdm.trigger(tp)
+    assert not tp.is_done()  # trigger alone must not terminate
+    assert tp.wait(timeout=10)
