@@ -74,7 +74,16 @@ class DataCopyFuture:
             if not self._done and self._trigger is not None:
                 trig, self._trigger = self._trigger, None
         if trig is not None:
-            self.set(trig())
+            try:
+                value = trig()
+            except BaseException:
+                # restore the trigger so other waiters aren't stranded on a
+                # future that can no longer resolve
+                with self._lock:
+                    if not self._done:
+                        self._trigger = trig
+                raise
+            self.set(value)
         if not self._event.wait(timeout):
             raise TimeoutError("datacopy future not resolved")
         return self._value
@@ -103,10 +112,19 @@ class ReshapeSpec:
         """
         dtype = shape = None
         if "type" in props:
-            v = constants.get(props["type"], props["type"])
-            if isinstance(v, ReshapeSpec):
+            name = props["type"]
+            if name not in constants:
+                # a [type=NAME] with no registered constant is a wire-layout
+                # tag (the reference's arena-datatype name for comm packing),
+                # not a local reshape request — ignore it here
+                v = None
+            else:
+                v = constants[name]
+            if v is None:
+                pass
+            elif isinstance(v, ReshapeSpec):
                 dtype, shape = v.dtype, v.shape
-            elif isinstance(v, tuple):
+            elif isinstance(v, tuple) and len(v) == 2:
                 dtype, shape = v
             else:
                 dtype = v
@@ -179,7 +197,17 @@ def get_copy_reshape(data: Data, spec: ReshapeSpec, device_index: int = 0) -> Da
     with _promises_lock:
         hit = _promises.get(key)
         if hit is not None:
-            return hit[1]
+            fut, reshaped = hit
+            rc = reshaped.newest_copy()
+            # a materialised promise is only reusable while it still holds
+            # the source's current version (the reference caches promises in
+            # the producing task's repo entry, so they die with the version;
+            # here we compare versions and rebuild when the source moved on)
+            if (not fut.is_ready()
+                    or src is None
+                    or (rc is not None and rc.version >= src.version)):
+                return reshaped
+            del _promises[key]
         reshaped = Data((data.key, "reshape", spec._key()),
                         shape=spec.shape or data.shape,
                         dtype=spec.dtype or data.dtype)

@@ -33,6 +33,7 @@ Usage::
 from __future__ import annotations
 
 import threading
+import types
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -56,21 +57,31 @@ DONT_TRACK = AccessMode.DONT_TRACK
 
 class _TileState:
     """Per-Data dependency tracking (reference dtd tile,
-    ``insert_function_internal.h:199-209``)."""
+    ``insert_function_internal.h:199-209``).
 
-    __slots__ = ("lock", "last_writer", "readers", "data")
+    ``current`` is the buffer holding the tile's latest logical version —
+    it diverges from the home ``data`` when a WAR hazard is broken by
+    renaming (reference ``overlap_strategies.c``): pending readers keep the
+    old buffer while the writer proceeds on a fresh one."""
+
+    __slots__ = ("lock", "last_writer", "readers", "atomic", "data", "current", "renames")
 
     def __init__(self, data: Optional[Data] = None) -> None:
         self.lock = threading.Lock()
         self.last_writer: Optional[Task] = None
         self.readers: List[Task] = []
+        #: pending commutative writers (ATOMIC_WRITE): unordered among
+        #: themselves, ordered against readers and exclusive writers
+        self.atomic: List[Task] = []
         self.data = data
+        self.current: Optional[Data] = data
+        self.renames = 0
 
 
 class _DTDTaskState:
     """Successor bookkeeping attached to each inserted task."""
 
-    __slots__ = ("lock", "pending", "successors", "completed")
+    __slots__ = ("lock", "pending", "successors", "completed", "gen", "args")
 
     def __init__(self) -> None:
         self.lock = threading.Lock()
@@ -79,6 +90,22 @@ class _DTDTaskState:
         self.pending = 1
         self.successors: List[Task] = []
         self.completed = False
+        #: untied-task support: a body returning a generator runs in slices,
+        #: the worker is released between them (reference dtd_test_untie.c)
+        self.gen = None
+        self.args: Optional[List[Any]] = None
+
+
+def copy_home(src: Data, dst: Data) -> None:
+    """Copy ``src``'s newest version into ``dst``'s CPU copy and bump its
+    version (shared by WAR-rename copies and flush-home)."""
+    arr = stage_to_cpu(src)
+    c = dst.get_copy(0)
+    if c is None:
+        dst.attach_copy(0, np.array(arr))
+    else:
+        c.payload = np.array(arr)
+    dst.version_bump(0)
 
 
 def stage_to_cpu(data: Data) -> np.ndarray:
@@ -115,6 +142,10 @@ class DTDTaskpool(Taskpool):
         self.threshold = mca_param.register(
             "dtd", "threshold_size", 1024,
             help="in-flight level the inserter drains down to when the window fills")
+        self._war_rename = mca_param.register(
+            "dtd", "war_rename", True,
+            help="break WAR hazards by renaming (fresh writer buffer) instead of serializing")
+        self._rename_tc: Optional[TaskClass] = None
         if context is not None and auto_add:
             context.add_taskpool(self)
 
@@ -157,8 +188,28 @@ class DTDTaskpool(Taskpool):
     def _make_hook(self, dev_type: str, fn: Callable):
         if dev_type == DEV_CPU:
             def cpu_hook(es, task, _fn=fn):
+                state: _DTDTaskState = task.user
+                if state.gen is not None:
+                    # untied resume: run the next slice on whichever worker
+                    # picked the task up (reference untied-task semantics)
+                    try:
+                        next(state.gen)
+                        return HookReturn.AGAIN
+                    except StopIteration as si:
+                        state.gen = None
+                        self._commit_outputs(task, state.args, si.value)
+                        return HookReturn.DONE
                 args = self._resolve_cpu_args(task)
                 result = _fn(*args)
+                if isinstance(result, types.GeneratorType):
+                    state.gen, state.args = result, args
+                    try:
+                        next(state.gen)
+                        return HookReturn.AGAIN
+                    except StopIteration as si:
+                        state.gen = None
+                        self._commit_outputs(task, args, si.value)
+                        return HookReturn.DONE
                 self._commit_outputs(task, args, result)
                 return HookReturn.DONE
 
@@ -180,7 +231,8 @@ class DTDTaskpool(Taskpool):
             kind, payload, mode = spec
             if kind == "data":
                 arr = stage_to_cpu(payload)
-                payload.transfer_ownership(0, mode & AccessMode.INOUT)
+                eff = AccessMode.INOUT if (mode & AccessMode.ATOMIC_WRITE) else (mode & AccessMode.INOUT)
+                payload.transfer_ownership(0, eff)
                 args.append(arr)
             elif kind == "scratch":
                 shape, dtype = payload
@@ -195,7 +247,7 @@ class DTDTaskpool(Taskpool):
         rebinds writable flows in order."""
         writable = [
             (i, spec) for i, spec in enumerate(task.body_args)
-            if spec[0] == "data" and (spec[2] & AccessMode.OUT)
+            if spec[0] == "data" and (spec[2] & (AccessMode.OUT | AccessMode.ATOMIC_WRITE))
         ]
         if result is not None:
             outs = result if isinstance(result, (tuple, list)) else (result,)
@@ -279,25 +331,64 @@ class DTDTaskpool(Taskpool):
 
         # dependency inference per tracked data argument (CTL args track
         # like readers: they order after the last writer)
-        for kind, data, mode in specs:
+        rename_on = bool(self._war_rename)
+        for i, (kind, data, mode) in enumerate(specs):
             if kind not in ("data", "ctl") or (mode & DONT_TRACK):
                 continue
             st = self._tile_state(data)
+            copy_src = copy_dst = None
+            copy_preds: List[Task] = []
             with st.lock:
-                if mode & AccessMode.OUT:  # writer (OUT/INOUT/ATOMIC_WRITE)
-                    preds = list(st.readers)
-                    if st.last_writer is not None:
-                        preds.append(st.last_writer)
-                    for p in preds:
-                        if p is task:
-                            continue
-                        self._add_edge(p, task, state)
-                    st.last_writer = task
-                    st.readers = []
-                else:  # reader
-                    if st.last_writer is not None and st.last_writer is not task:
-                        self._add_edge(st.last_writer, task, state)
+                st.readers = [r for r in st.readers if not r.user.completed]
+                st.atomic = [w for w in st.atomic if not w.user.completed]
+                buf = st.current if st.current is not None else data
+                last = [st.last_writer] if st.last_writer is not None else []
+                if mode & AccessMode.ATOMIC_WRITE:
+                    # commutative writer: after readers + exclusive writer,
+                    # unordered among atomic peers
+                    for p in st.readers + last:
+                        if p is not task:
+                            self._add_edge(p, task, state)
+                    st.atomic.append(task)
+                elif mode & AccessMode.OUT:  # exclusive writer (OUT/INOUT)
+                    pending = [r for r in st.readers + st.atomic if r is not task]
+                    if rename_on and kind == "data" and pending:
+                        # WAR hazard: rename (overlap_strategies.c) — the
+                        # writer proceeds on a fresh buffer while pending
+                        # readers/atomics keep the old one
+                        st.renames += 1
+                        newd = Data((data.key, "war", st.renames),
+                                    shape=buf.shape, dtype=buf.dtype)
+                        if mode & AccessMode.IN:
+                            # INOUT: the new buffer needs the old contents —
+                            # a copy task ordered after the old buffer's
+                            # producers (but NOT after its readers)
+                            copy_src, copy_dst = buf, newd
+                            copy_preds = [p for p in last + st.atomic if p is not task]
+                        else:
+                            self._attach_blank(newd, buf)
+                        st.current = newd
+                        st.last_writer = task
+                        st.readers = []
+                        st.atomic = []
+                        buf = newd
+                    else:
+                        for p in pending + last:
+                            if p is not task:
+                                self._add_edge(p, task, state)
+                        st.last_writer = task
+                        st.readers = []
+                        st.atomic = []
+                else:  # reader: after exclusive writer + atomic writers
+                    for p in st.atomic + last:
+                        if p is not task:
+                            self._add_edge(p, task, state)
                     st.readers.append(task)
+            if kind == "data":
+                specs[i] = (kind, buf, mode)  # bind the version's buffer
+            if copy_src is not None:
+                cpy = self._insert_rename_copy(copy_src, copy_dst, copy_preds)
+                self._add_edge(cpy, task, state)
 
         with self._quiesce:
             self._inserted += 1
@@ -311,6 +402,50 @@ class DTDTaskpool(Taskpool):
             self.context.schedule([task], es=es)
         self._throttle_window()
         return task
+
+    @staticmethod
+    def _attach_blank(newd: Data, like: Data) -> None:
+        """Allocate a pure-OUT rename target shaped like the old buffer."""
+        c = like.newest_copy()
+        if c is not None:
+            arr = np.zeros_like(np.asarray(c.payload))
+        else:
+            arr = np.zeros(like.shape or (1,), like.dtype or np.float64)
+        newd.attach_copy(0, arr)
+
+    def _rename_class(self) -> TaskClass:
+        if self._rename_tc is None:
+            def copy_hook(es, t):
+                src, dst = t.body_args
+                copy_home(src, dst)
+                return HookReturn.DONE
+
+            tc = TaskClass("war_rename_copy", chores=[Chore(DEV_CPU, copy_hook)])
+            tc.release_deps = self._release_deps
+            self._rename_tc = tc
+            self.add_task_class(tc)
+        return self._rename_tc
+
+    def _insert_rename_copy(self, src: Data, dst: Data, preds: List[Task]) -> Task:
+        """Internal insertion of the INOUT-rename copy task: reads the old
+        buffer's final version into the writer's fresh buffer; ordered after
+        the old buffer's producers only (readers run concurrently)."""
+        t = Task(self, self._rename_class(), (self._inserted,), priority=0)
+        t.body_args = (src, dst)
+        st = _DTDTaskState()
+        t.user = st
+        t.on_complete = self._task_retired
+        for p in preds:
+            self._add_edge(p, t, st)
+        with self._quiesce:
+            self._inserted += 1
+        ready = False
+        with st.lock:
+            st.pending -= 1
+            ready = st.pending == 0
+        if ready:
+            self.context.schedule([t], es=self.context.current_es())
+        return t
 
     @staticmethod
     def _add_edge(pred: Task, succ: Task, succ_state: "_DTDTaskState") -> None:
@@ -394,9 +529,16 @@ class DTDTaskpool(Taskpool):
     def data_flush(self, data: Data) -> None:
         """Push the final version of ``data`` home to its owner rank
         (reference ``parsec_dtd_data_flush``, insert_function.h:351-360).
-        Locally: materialize the newest version on the CPU device and drop
-        tracking state."""
-        stage_to_cpu(data)
+        Locally: materialize the newest version on the CPU device — copying
+        it back from a rename buffer if WAR renaming redirected the tile —
+        and drop tracking state."""
+        with self._tiles_lock:
+            st = self._tiles.get(data.data_id)
+        cur = st.current if st is not None and st.current is not None else data
+        if cur is not data:
+            copy_home(cur, data)
+        else:
+            stage_to_cpu(data)
         with self._tiles_lock:
             self._tiles.pop(data.data_id, None)
 

@@ -1,0 +1,164 @@
+"""Static task-graph capture for PTG taskpools.
+
+The reference never materialises the whole DAG — it is implicit in the
+generated ``iterate_successors`` code.  Capturing it explicitly enables
+three subsystems that the reference implements as separate machinery:
+
+* the ``iterators_checker`` PINS module
+  (``/root/reference/parsec/mca/pins/iterators_checker/``) — validating at
+  runtime that released successors match the declared dependencies;
+* the ``ptg_to_dtd`` PINS module (``mca/pins/ptg_to_dtd/``) — replaying a
+  PTG taskpool through the DTD engine as a DSL-equivalence harness;
+* the whole-DAG XLA lowering (TPU-native: compile the entire tile DAG into
+  one jitted program — the analogue of CUDA-graph capture, but done by the
+  XLA compiler with full fusion/overlap freedom).
+
+Capture cost is O(tasks + edges) expression evaluations; it is a test/
+lowering tool, not a hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core.lifecycle import AccessMode
+from .ptg import (
+    CTL,
+    PTGTaskClass,
+    PTGTaskpool,
+    _DataRef,
+    _NewRef,
+    _NoneRef,
+    _TaskRef,
+    _expand_args,
+)
+
+TaskId = Tuple[str, Tuple]  # (class name, locals)
+
+
+class TaskNode:
+    __slots__ = ("tid", "priority", "rank", "in_edges", "out_edges", "flow_sources", "write_backs")
+
+    def __init__(self, tid: TaskId, priority: int, rank: int):
+        self.tid = tid
+        self.priority = priority
+        self.rank = rank
+        #: flow name -> ("data", collection_name, key) | ("task", producer
+        #: tid, producer flow) | ("new",) | None
+        self.flow_sources: Dict[str, Optional[Tuple]] = {}
+        #: (flow name, collection name, key) final write-backs
+        self.write_backs: List[Tuple[str, str, Tuple]] = []
+        #: edges as (my flow, successor tid, successor flow)
+        self.out_edges: List[Tuple[str, TaskId, str]] = []
+        #: predecessor count (dependency goal)
+        self.in_edges: int = 0
+
+
+class TaskGraph:
+    def __init__(self, tp: PTGTaskpool):
+        self.taskpool = tp
+        self.nodes: Dict[TaskId, TaskNode] = {}
+
+    def successors(self, tid: TaskId) -> List[TaskId]:
+        return [s for (_f, s, _sf) in self.nodes[tid].out_edges]
+
+    def topo_order(self) -> List[TaskId]:
+        """Kahn topological order, priority-aware among ready nodes."""
+        indeg = {tid: n.in_edges for tid, n in self.nodes.items()}
+        ready = [tid for tid, d in indeg.items() if d == 0]
+        out: List[TaskId] = []
+        while ready:
+            ready.sort(key=lambda t: -self.nodes[t].priority)
+            tid = ready.pop(0)
+            out.append(tid)
+            # in_edges (goal_of) counts one per declared dep instance, which
+            # is exactly how out_edges are enumerated — decrement per edge
+            for (_f, succ, _sf) in self.nodes[tid].out_edges:
+                indeg[succ] -= 1
+                if indeg[succ] == 0:
+                    ready.append(succ)
+        if len(out) != len(self.nodes):
+            stuck = [t for t, d in indeg.items() if d > 0]
+            raise RuntimeError(f"task graph has a cycle or broken deps: stuck={stuck[:5]}")
+        return out
+
+
+def capture(tp: PTGTaskpool, ranks: Optional[Iterable[int]] = None) -> TaskGraph:
+    """Evaluate every task's dependency expressions and materialise the DAG.
+
+    ``ranks=None`` captures all tasks; otherwise only tasks whose affinity
+    maps into ``ranks`` (matching each rank's local view).
+    """
+    g = TaskGraph(tp)
+    consts = tp.constants
+    rankset = set(ranks) if ranks is not None else None
+
+    # pass 1: nodes
+    for pc in tp.ptg.classes.values():
+        for loc in pc.param_space(consts):
+            rank = pc.rank_of(loc, consts)
+            if rankset is not None and rank not in rankset:
+                continue
+            tid = (pc.name, loc)
+            g.nodes[tid] = TaskNode(tid, pc.priority_of(loc, consts), rank)
+
+    # pass 2: edges + sources (driven from each node's own deps)
+    for tid, node in g.nodes.items():
+        pc = tp.ptg.classes[tid[0]]
+        loc = tid[1]
+        env = pc.env_of(loc, consts)
+        node.in_edges = pc.goal_of(loc, consts)
+        for f in pc.flows:
+            # input source
+            src = pc.active_input(f, env)
+            if src is None or isinstance(src, _NoneRef):
+                node.flow_sources[f.name] = ("new",) if (f.mode & AccessMode.OUT) else None
+            elif isinstance(src, _NewRef):
+                node.flow_sources[f.name] = ("new",)
+            elif isinstance(src, _DataRef):
+                node.flow_sources[f.name] = ("data", src.collection_name, src.key(env))
+            else:  # _TaskRef
+                key = tuple(a.scalar(env) for a in src.args)
+                node.flow_sources[f.name] = ("task", (src.class_name, key), src.flow_name)
+            # output edges
+            for dep in f.deps_out:
+                t = dep.target(env)
+                if t is None or isinstance(t, (_NoneRef, _NewRef)):
+                    continue
+                if isinstance(t, _DataRef):
+                    node.write_backs.append((f.name, t.collection_name, t.key(env)))
+                    continue
+                succ_pc = tp.ptg.classes[t.class_name]
+                for locs in _expand_args(t.args, env):
+                    if len(locs) != len(succ_pc.param_names):
+                        continue
+                    if not succ_pc.valid(locs, consts):
+                        continue
+                    stid = (t.class_name, locs)
+                    if stid in g.nodes:
+                        node.out_edges.append((f.name, stid, t.flow_name))
+    return g
+
+
+def source_tile(g: TaskGraph, tid: TaskId, flow_name: str):
+    """Follow a flow's input chain to its ultimate memory source.
+
+    Returns ``("data", collection_name, key)`` or ``("new", producer_tid,
+    flow)`` — the identity that aliases across the producer/consumer chain
+    (PTG flows thread one datum through in-place bodies).
+    """
+    seen = set()
+    cur, cflow = tid, flow_name
+    while True:
+        if (cur, cflow) in seen:
+            raise RuntimeError(f"cyclic flow chain at {cur}/{cflow}")
+        seen.add((cur, cflow))
+        src = g.nodes[cur].flow_sources.get(cflow)
+        if src is None:
+            return ("new", cur, cflow)
+        if src[0] == "data":
+            return src
+        if src[0] == "new":
+            return ("new", cur, cflow)
+        _, ptid, pflow = src
+        cur, cflow = ptid, pflow

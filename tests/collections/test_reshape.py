@@ -136,3 +136,24 @@ def test_ptg_type_prop_from_constants(ctx):
     ctx.add_taskpool(tp)
     assert tp.wait(timeout=30)
     assert seen["dtype"] == np.float32 and seen["shape"] == (2, 3)
+
+
+def test_reshape_promise_invalidated_on_new_version():
+    """A materialised promise must not serve a stale version after the
+    source tile is rewritten (repo-entry-lifetime semantics)."""
+    d = data_create("c", payload=np.arange(4, dtype=np.float64))
+    spec = ReshapeSpec(dtype=np.float32)
+    r1 = materialize(get_copy_reshape(d, spec))
+    np.testing.assert_allclose(r1.newest_copy().payload, np.arange(4))
+    # producer rewrites the tile (new version)
+    c = d.get_copy(0)
+    c.payload = np.arange(4, dtype=np.float64) + 100
+    d.version_bump(0)
+    r2 = materialize(get_copy_reshape(d, spec))
+    np.testing.assert_allclose(r2.newest_copy().payload, np.arange(4) + 100)
+
+
+def test_reshape_unknown_type_name_is_wire_tag():
+    """[type=NAME] with no registered constant is a comm-layout tag, not a
+    local reshape — from_props must ignore it."""
+    assert ReshapeSpec.from_props({"type": "DEFAULT"}, {}) is None

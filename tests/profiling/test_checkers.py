@@ -1,0 +1,113 @@
+"""iterators_checker + ptg_to_dtd PINS modules (reference
+``mca/pins/iterators_checker``, ``mca/pins/ptg_to_dtd``)."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.datadist import TiledMatrix
+from parsec_tpu.dsl.graph import capture, source_tile
+from parsec_tpu.dsl.ptg import PTG, IN, INOUT
+from parsec_tpu.dsl.ptg_to_dtd import replay_via_dtd
+from parsec_tpu.profiling.checkers import IteratorsChecker
+
+
+@pytest.fixture
+def ctx():
+    c = Context(nb_cores=4)
+    yield c
+    c.fini()
+
+
+def _chain_ptg(n=10):
+    dc = LocalCollection("D", shape=(1,), init=lambda k: np.zeros(1))
+    ptg = PTG("chain")
+    step = ptg.task_class("step", k=f"0 .. N-1")
+    step.affinity("D(0)")
+    step.flow("X", INOUT,
+              "<- (k == 0) ? D(0) : X step(k-1)",
+              "-> (k < N-1) ? X step(k+1) : D(0)")
+    step.body(cpu=lambda X, k: X.__iadd__(k))
+    return ptg, dc, n
+
+
+def test_capture_chain_structure():
+    ptg, dc, n = _chain_ptg()
+    tp = ptg.taskpool(N=n, D=dc)
+    g = capture(tp)
+    assert len(g.nodes) == n
+    assert g.nodes[("step", (0,))].in_edges == 0
+    for k in range(1, n):
+        assert g.nodes[("step", (k,))].in_edges == 1
+    assert g.successors(("step", (3,))) == [("step", (4,))]
+    order = g.topo_order()
+    assert order == [("step", (k,)) for k in range(n)]
+    # every flow chain roots at the home tile
+    assert source_tile(g, ("step", (7,)), "X") == ("data", "D", (0,))
+    # final write-back declared on the last task
+    assert g.nodes[("step", (n - 1,))].write_backs == [("X", "D", (0,))]
+
+
+def test_iterators_checker_clean_run(ctx):
+    ptg, dc, n = _chain_ptg()
+    tp = ptg.taskpool(N=n, D=dc)
+    with IteratorsChecker() as chk:
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=30)
+    assert chk.verify(tp) == []
+    assert len([e for e in chk.executed if e[0] == tp.taskpool_id]) == n
+
+
+def test_iterators_checker_catches_missing_execution(ctx):
+    """A declared task that never runs must be reported."""
+    ptg, dc, n = _chain_ptg()
+    tp = ptg.taskpool(N=n, D=dc)
+    with IteratorsChecker() as chk:
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=30)
+    # claim the DAG had one more task than was executed
+    tp2 = ptg.taskpool(N=n + 1, D=dc)
+    errs = chk.verify(tp2)
+    assert any("never executed" in e for e in errs)
+
+
+def test_ptg_to_dtd_chain_equivalence(ctx):
+    ptg, dc, n = _chain_ptg()
+    tp = ptg.taskpool(N=n, D=dc)
+    replay_via_dtd(tp, ctx)
+    np.testing.assert_allclose(dc.data_of(0).newest_copy().payload, sum(range(n)))
+
+
+def test_ptg_to_dtd_dag_gemm_like(ctx):
+    """2D wavefront: C(i,j) += row/col neighbours — exercises fan-in/fan-out
+    and write-backs through the DTD replay."""
+    M = TiledMatrix(8, 8, 4, 4, name="C", dtype=np.float64)
+    M.from_array(np.ones((8, 8)))
+
+    ptg = PTG("wave")
+    t = ptg.task_class("t", i="0 .. 1", j="0 .. 1")
+    t.affinity("C(i, j)")
+    t.flow("X", INOUT,
+           "<- (i == 0 and j == 0) ? C(i, j)",
+           "<- (j > 0) ? X t(i, j-1)",
+           "<- (i > 0 and j == 0) ? X t(i-1, 1)",
+           "-> (j < 1) ? X t(i, j+1)",
+           "-> (j == 1 and i < 1) ? X t(i+1, 0)",
+           "-> C(i, j)")
+    t.body(cpu=lambda X, i, j: X.__iadd__(10 * i + j))
+
+    # PTG reference execution
+    tp = ptg.taskpool(C=M)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=30)
+    ref = M.to_array().copy()
+
+    # DTD replay on a fresh matrix
+    M2 = TiledMatrix(8, 8, 4, 4, name="C", dtype=np.float64)
+    M2.from_array(np.ones((8, 8)))
+    tp2 = ptg.taskpool(C=M2)
+    replay_via_dtd(tp2, ctx)
+    # the wavefront threads ONE datum: every tile of the chain accumulated
+    # into the chain's source tile C(0,0); write-backs copy it to each home
+    np.testing.assert_allclose(M2.to_array(), ref)
