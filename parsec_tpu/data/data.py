@@ -86,6 +86,7 @@ class Data:
         "lock",
         "data_id",
         "user",
+        "__weakref__",
     )
 
     def __init__(

@@ -23,6 +23,7 @@ tile should not bounce through the host.
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -36,18 +37,19 @@ class DataCopyFuture:
     that produces the value on first demand, and notifies completion
     callbacks exactly once."""
 
-    __slots__ = ("_lock", "_value", "_done", "_trigger", "_callbacks", "_event")
+    __slots__ = ("_lock", "_value", "_exc", "_done", "_trigger", "_callbacks", "_event")
 
     def __init__(self, trigger: Optional[Callable[[], DataCopy]] = None):
         self._lock = threading.Lock()
         self._value: Optional[DataCopy] = None
+        self._exc: Optional[BaseException] = None
         self._done = False
         self._trigger = trigger
         self._callbacks: List[Callable[[DataCopy], None]] = []
         self._event = threading.Event()
 
     def is_ready(self) -> bool:
-        return self._done
+        return self._done and self._exc is None
 
     def set(self, value: DataCopy) -> None:
         with self._lock:
@@ -60,12 +62,24 @@ class DataCopyFuture:
         for cb in cbs:
             cb(value)
 
+    def set_exception(self, exc: BaseException) -> None:
+        """Resolve exceptionally: every current and future waiter re-raises
+        (a stranded waiter is worse than a propagated error)."""
+        with self._lock:
+            if self._done:
+                return
+            self._exc = exc
+            self._done = True
+            self._callbacks = []
+        self._event.set()
+
     def on_ready(self, cb: Callable[[DataCopy], None]) -> None:
         with self._lock:
             if not self._done:
                 self._callbacks.append(cb)
                 return
-        cb(self._value)  # already resolved
+        if self._exc is None:
+            cb(self._value)  # already resolved
 
     def get(self, timeout: Optional[float] = None) -> DataCopy:
         """Demand the value, running the lazy trigger if nobody has yet."""
@@ -76,16 +90,14 @@ class DataCopyFuture:
         if trig is not None:
             try:
                 value = trig()
-            except BaseException:
-                # restore the trigger so other waiters aren't stranded on a
-                # future that can no longer resolve
-                with self._lock:
-                    if not self._done:
-                        self._trigger = trig
+            except BaseException as e:
+                self.set_exception(e)
                 raise
             self.set(value)
         if not self._event.wait(timeout):
             raise TimeoutError("datacopy future not resolved")
+        if self._exc is not None:
+            raise self._exc
         return self._value
 
 
@@ -177,9 +189,18 @@ class ReshapeSpec:
         return f"ReshapeSpec(dtype={self.dtype}, shape={self.shape})"
 
 
-# promise cache: (data_id, spec) -> (future, reshaped Data)
+# promise cache: (data_id, spec) -> (future, reshaped Data); entries are
+# evicted when the source Data is garbage-collected (weakref.finalize)
 _promises: Dict[Tuple[int, ReshapeSpec], Tuple[DataCopyFuture, Data]] = {}
 _promises_lock = threading.Lock()
+_finalized: set = set()
+
+
+def _evict_promises_of(data_id: int) -> None:
+    with _promises_lock:
+        _finalized.discard(data_id)
+        for k in [k for k in _promises if k[0] == data_id]:
+            del _promises[k]
 
 
 def get_copy_reshape(data: Data, spec: ReshapeSpec, device_index: int = 0) -> Data:
@@ -208,14 +229,26 @@ def get_copy_reshape(data: Data, spec: ReshapeSpec, device_index: int = 0) -> Da
                     or (rc is not None and rc.version >= src.version)):
                 return reshaped
             del _promises[key]
+        else:
+            # evict this source's promises when the Data is collected so the
+            # process-global cache cannot grow without bound
+            if data.data_id not in _finalized:
+                _finalized.add(data.data_id)
+                weakref.finalize(data, _evict_promises_of, data.data_id)
         reshaped = Data((data.key, "reshape", spec._key()),
                         shape=spec.shape or data.shape,
                         dtype=spec.dtype or data.dtype)
+        # the trigger must not pin the source: cache -> future -> trigger ->
+        # data would keep every source alive and the finalizer would never run
+        dref = weakref.ref(data)
 
         def trigger() -> DataCopy:
-            s = data.newest_copy()
+            d = dref()
+            if d is None:
+                raise RuntimeError("reshape source Data was collected")
+            s = d.newest_copy()
             if s is None:
-                raise RuntimeError(f"reshape of {data!r}: no valid source copy")
+                raise RuntimeError(f"reshape of {d!r}: no valid source copy")
             out = spec.apply(s.payload)
             c = reshaped.attach_copy(s.device_index if device_index is None else device_index, out)
             c.coherency = Coherency.SHARED

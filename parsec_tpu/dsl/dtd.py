@@ -256,6 +256,13 @@ class DTDTaskpool(Taskpool):
                     f"{task!r}: body returned {len(outs)} outputs for "
                     f"{len(writable)} writable flows")
             for (i, spec), new in zip(writable, outs):
+                if spec[2] & AccessMode.ATOMIC_WRITE:
+                    # concurrent atomic writers each computed from their own
+                    # snapshot; rebinding would lose peer updates — atomic
+                    # bodies must mutate in place
+                    raise ValueError(
+                        f"{task!r}: ATOMIC_WRITE flows require in-place "
+                        "mutation, not a returned replacement array")
                 copy = spec[1].get_copy(0)
                 copy.payload = np.asarray(new)
         for i, spec in writable:

@@ -107,7 +107,6 @@ def capture(tp: PTGTaskpool, ranks: Optional[Iterable[int]] = None) -> TaskGraph
         pc = tp.ptg.classes[tid[0]]
         loc = tid[1]
         env = pc.env_of(loc, consts)
-        node.in_edges = pc.goal_of(loc, consts)
         for f in pc.flows:
             # input source
             src = pc.active_input(f, env)
@@ -137,6 +136,14 @@ def capture(tp: PTGTaskpool, ranks: Optional[Iterable[int]] = None) -> TaskGraph
                     stid = (t.class_name, locs)
                     if stid in g.nodes:
                         node.out_edges.append((f.name, stid, t.flow_name))
+
+    # pass 3: in-degrees tallied from the captured edges (NOT goal_of: a
+    # rank-filtered capture must count only edges whose producer is in the
+    # capture, or the topological order could never retire cross-rank
+    # consumers; remote releases arrive outside this subgraph)
+    for node in g.nodes.values():
+        for (_f, succ, _sf) in node.out_edges:
+            g.nodes[succ].in_edges += 1
     return g
 
 

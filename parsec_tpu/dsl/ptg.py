@@ -487,9 +487,17 @@ class PTGTaskpool(Taskpool):
                 data = self._resolve_input(pc, f, target, env, task)
                 if data is not None and dep is not None and dep.props:
                     # dep-level reshape request (reference
-                    # parsec_get_copy_reshape_from_dep, parsec_reshape.c)
+                    # parsec_get_copy_reshape_from_dep, parsec_reshape.c);
+                    # input-side reshape only makes sense for read-only
+                    # flows — a writable flow would divert its writes into
+                    # the converted copy and corrupt the home tile
                     rspec = ReshapeSpec.from_props(dep.props, self.constants)
                     if rspec is not None:
+                        if f.mode & AccessMode.OUT:
+                            raise ValueError(
+                                f"{pc.name}.{f.name}: reshape props "
+                                f"{dep.props} on a writable flow are not "
+                                "supported (reads would be diverted)")
                         data = materialize(get_copy_reshape(data, rspec))
                 specs.append(("data", data, f.mode))
                 task.data_in[f.index] = data.newest_copy() if data is not None else None
