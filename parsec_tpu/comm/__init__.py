@@ -12,12 +12,15 @@ from .engine import (
 )
 from .inproc import InprocComm, InprocFabric
 from .remote_dep import RemoteDepManager
+from .tcp import TCPComm, endpoint_from_env
 
 __all__ = [
     "CommEngine",
     "InprocComm",
     "InprocFabric",
     "RemoteDepManager",
+    "TCPComm",
+    "endpoint_from_env",
     "TAG_ACTIVATE",
     "TAG_GET",
     "TAG_PUT",

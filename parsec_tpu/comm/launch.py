@@ -1,0 +1,68 @@
+"""Multi-process launcher for the TCP backend.
+
+Reference analog: the ctest harness launches "multi-node" tests as
+``mpiexec -np N`` on one node (``/root/reference/CMakeLists.txt:967-983``).
+Here the launcher spawns N Python processes, hands each a rank via the
+environment, and lets them rendezvous through a shared directory; it works
+unchanged across hosts when ``rendezvous_dir`` sits on a shared filesystem
+or an explicit ``host:port`` peer list is given.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Dict, List, Optional, Sequence
+
+
+def launch(
+    nranks: int,
+    argv: Sequence[str],
+    *,
+    rendezvous_dir: Optional[str] = None,
+    env: Optional[Dict[str, str]] = None,
+    timeout: float = 300.0,
+    python: Optional[str] = None,
+) -> List[subprocess.CompletedProcess]:
+    """Run ``python argv...`` once per rank; returns per-rank results.
+
+    Raises on nonzero exit (with the failing rank's stderr attached).
+    """
+    rdv = rendezvous_dir or tempfile.mkdtemp(prefix="parsec_tpu_rdv_")
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    procs = []
+    for r in range(nranks):
+        child_env = dict(os.environ)
+        prev = child_env.get("PYTHONPATH")
+        child_env["PYTHONPATH"] = pkg_root + (os.pathsep + prev if prev else "")
+        child_env.update(env or {})
+        child_env.update({
+            "PARSEC_TPU_RANK": str(r),
+            "PARSEC_TPU_NRANKS": str(nranks),
+            "PARSEC_TPU_RDV": rdv,
+        })
+        procs.append(subprocess.Popen(
+            [python or sys.executable, *argv],
+            env=child_env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True))
+    results = []
+    failed = []
+    for r, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            failed.append((r, "timeout", out, err))
+            continue
+        results.append(subprocess.CompletedProcess(p.args, p.returncode, out, err))
+        if p.returncode != 0:
+            failed.append((r, p.returncode, out, err))
+    if failed:
+        msgs = "\n".join(
+            f"--- rank {r} ({why}) ---\nstdout:\n{out}\nstderr:\n{err[-4000:]}"
+            for r, why, out, err in failed)
+        raise RuntimeError(f"{len(failed)}/{nranks} ranks failed:\n{msgs}")
+    return results

@@ -42,8 +42,10 @@ class RemoteDepManager:
         self.short_limit = mca_param.register(
             "runtime", "comm_short_limit", 1 << 16,
             help="payloads at or below this inline with activations (bytes)")
-        ce.register_am(TAG_ACTIVATE, self._on_activate)
         self.stats = collections.Counter()
+        # register LAST: backends with a live comm thread may replay parked
+        # activations synchronously from inside register_am
+        ce.register_am(TAG_ACTIVATE, self._on_activate)
 
     # -- taskpool registry ----------------------------------------------
     def new_taskpool(self, tp) -> None:

@@ -1,0 +1,440 @@
+"""TCP/DCN multi-process comm backend with a funnelled comm thread.
+
+Reference: ``/root/reference/parsec/parsec_mpi_funnelled.c`` — the MPI
+backend runs a single dedicated communication thread ("funnelled") that
+owns every network endpoint; workers enqueue typed commands to a MPSC
+queue and the comm thread drains it, aggregates messages per peer
+(``remote_dep_mpi.c:1066-1190`` per-peer rings), posts sends, and
+dispatches incoming active messages.  One-sided ``put``/``get`` are
+*emulated* with an AM handshake on internal tags
+(``parsec_mpi_funnelled.c:273,361,949-960``).
+
+This backend keeps that exact architecture over TCP sockets — the
+DCN-style transport for a TPU pod's hosts (ICI collectives live in
+:mod:`parsec_tpu.parallel`; the runtime's point-to-point dataflow rides
+the host network, SURVEY.md §5.8):
+
+* full-mesh connectivity: rank *i* accepts from ranks *j > i* and
+  connects to ranks *j < i*; a 4-byte handshake carries the peer rank;
+* rendezvous through a shared directory (each rank binds an ephemeral
+  port and publishes ``<rank>.addr``) or an explicit ``peers`` list of
+  ``host:port`` — the multi-host form;
+* frames are ``[u32 length | pickle((src, [(tag, payload), ...]))]`` —
+  a frame carries a *batch*: every AM queued for the same peer at drain
+  time travels in one frame (the per-peer aggregation of the reference);
+* the comm thread dispatches AM callbacks directly (funnelled semantics:
+  callbacks schedule work into the owning context's queues, exactly like
+  the reference comm thread running ``release_deps``).
+
+Trust model: endpoints are the runtime's own cooperating processes
+(pickle on the wire, like MPI's trusted-cluster assumption); do not
+expose the rendezvous port to untrusted networks.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import pickle
+import queue
+import select
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils import debug, register_component
+from .engine import CommEngine, MAX_AM_TAGS
+
+# internal tag space (reference registers internal GET/PUT AM tags at init,
+# parsec_mpi_funnelled.c:583-592); user tags must stay below these.
+TAG_BARRIER = MAX_AM_TAGS - 3     # 9
+TAG_GET_REQ = MAX_AM_TAGS - 2     # 10
+TAG_GET_ANS = MAX_AM_TAGS - 1     # 11
+
+_LEN = struct.Struct("!I")
+_RANK = struct.Struct("!i")
+_MISSING = object()
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+@register_component("comm")
+class TCPComm(CommEngine):
+    """One endpoint of the TCP fabric (one per process/rank)."""
+
+    mca_name = "tcp"
+    mca_priority = 20
+
+    def __init__(
+        self,
+        rank: int,
+        nranks: int,
+        rendezvous_dir: Optional[str] = None,
+        peers: Optional[List[str]] = None,
+        host: str = "127.0.0.1",
+        connect_timeout: float = 60.0,
+    ):
+        self.rank = rank
+        self.nranks = nranks
+        self.context = None
+        self.stats: collections.Counter = collections.Counter()
+        self._am: Dict[int, Callable[[int, Any], None]] = {}
+        # AMs that raced ahead of their tag registration are parked and
+        # replayed at register time (the reference preposts persistent
+        # recvs per registered tag, so a message can never outrun its
+        # handler; this is the stream-socket analog).  _am_lock closes the
+        # window between the comm thread's lookup-then-park and the main
+        # thread's register-then-replay.
+        self._am_lock = threading.Lock()
+        self._unclaimed: Dict[int, List[Tuple[int, Any]]] = collections.defaultdict(list)
+        self._mem: Dict[Any, Any] = {}
+        self._mem_lock = threading.Lock()
+        self._pending_gets: Dict[int, Callable[[Any], None]] = {}
+        self._get_seq = 0
+        self._get_lock = threading.Lock()
+        # MPSC command queue drained by the comm thread (reference
+        # dep_cmd_queue, remote_dep_mpi.c:513-520)
+        self._cmds: "queue.SimpleQueue[Tuple[int, int, Any]]" = queue.SimpleQueue()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)  # a full wake pipe is skipped, not blocked on
+        self._closing = threading.Event()
+        self._barrier_epoch = 0
+        self._barrier_state: Dict[int, Any] = {}
+        self._barrier_cv = threading.Condition()
+
+        self._socks: Dict[int, socket.socket] = {}
+        self._rdbuf: Dict[int, bytearray] = {}
+        if nranks > 1:
+            self._bootstrap(rendezvous_dir, peers, host, connect_timeout)
+
+        self.register_am(TAG_GET_REQ, self._on_get_req)
+        self.register_am(TAG_GET_ANS, self._on_get_ans)
+        self.register_am(TAG_BARRIER, self._on_barrier)
+
+        self._thread = threading.Thread(
+            target=self._comm_main, name=f"parsec-comm-{rank}", daemon=True)
+        self._thread.start()
+
+    # -- bootstrap -------------------------------------------------------
+    def _bootstrap(self, rdv: Optional[str], peers: Optional[List[str]],
+                   host: str, timeout: float) -> None:
+        lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lsock.bind((host, 0))
+        lsock.listen(self.nranks)
+        my_port = lsock.getsockname()[1]
+
+        if peers is None:
+            if rdv is None:
+                raise ValueError("TCPComm needs rendezvous_dir or peers")
+            os.makedirs(rdv, exist_ok=True)
+            tmp = os.path.join(rdv, f".{self.rank}.addr.tmp")
+            with open(tmp, "w") as f:
+                f.write(f"{host}:{my_port}")
+            os.replace(tmp, os.path.join(rdv, f"{self.rank}.addr"))
+            peers = [None] * self.nranks
+            deadline = time.time() + timeout
+            for r in range(self.nranks):
+                path = os.path.join(rdv, f"{r}.addr")
+                while not os.path.exists(path):
+                    if time.time() > deadline:
+                        raise TimeoutError(f"rendezvous: rank {r} missing")
+                    time.sleep(0.01)
+                with open(path) as f:
+                    peers[r] = f.read().strip()
+
+        # connect DOWN, accept UP; peers may not have bound yet (explicit
+        # peer lists have no publish-after-listen ordering), so refused
+        # connections retry until the deadline
+        for r in range(self.rank):
+            h, p = peers[r].rsplit(":", 1)
+            deadline = time.time() + timeout
+            while True:
+                try:
+                    s = socket.create_connection((h, int(p)), timeout=timeout)
+                    break
+                except (ConnectionRefusedError, socket.timeout, OSError):
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.05)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.sendall(_RANK.pack(self.rank))
+            self._socks[r] = s
+        for _ in range(self.rank + 1, self.nranks):
+            lsock.settimeout(timeout)
+            s, _addr = lsock.accept()
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            (peer_rank,) = _RANK.unpack(_recv_exact(s, _RANK.size))
+            self._socks[peer_rank] = s
+        lsock.close()
+        for s in self._socks.values():
+            s.setblocking(False)
+        self._rdbuf = {r: bytearray() for r in self._socks}
+
+    # -- AM --------------------------------------------------------------
+    def register_am(self, tag: int, cb) -> None:
+        if tag >= MAX_AM_TAGS:
+            raise ValueError(f"tag {tag} out of tag space")
+        with self._am_lock:
+            self._am[tag] = cb
+            parked = self._unclaimed.pop(tag, None)
+        if parked:
+            for src, payload in parked:
+                self._dispatch(tag, src, payload)
+
+    def send_am(self, tag: int, dst_rank: int, payload: Any) -> None:
+        self.stats[f"am_sent_{tag}"] += 1
+        if dst_rank == self.rank:
+            # self-sends short-circuit (reference delivers locally too)
+            self._dispatch(tag, self.rank, payload)
+            return
+        self._cmds.put((dst_rank, tag, payload))
+        try:
+            self._wake_w.send(b"\0")
+        except (BlockingIOError, OSError):
+            pass
+
+    # -- one-sided (AM-handshake emulation) ------------------------------
+    def mem_register(self, handle: Any, buffer: Any) -> None:
+        with self._mem_lock:
+            self._mem[handle] = buffer
+
+    def mem_unregister(self, handle: Any) -> None:
+        with self._mem_lock:
+            self._mem.pop(handle, None)
+
+    def get(self, src_rank: int, handle: Any, on_done) -> None:
+        if src_rank == self.rank:
+            with self._mem_lock:
+                buf = self._mem.get(handle)
+            if buf is None:
+                raise KeyError(f"no registered memory {handle!r} locally")
+            on_done(buf)
+            return
+        with self._get_lock:
+            self._get_seq += 1
+            req = self._get_seq
+            self._pending_gets[req] = on_done
+        self.send_am(TAG_GET_REQ, src_rank, {"req": req, "handle": handle})
+
+    def _on_get_req(self, src: int, msg: dict) -> None:
+        with self._mem_lock:
+            buf = self._mem.get(msg["handle"], _MISSING)
+        if buf is _MISSING:
+            debug.error("rank %d: GET for unknown handle %r", self.rank, msg["handle"])
+            self.send_am(TAG_GET_ANS, src,
+                         {"req": msg["req"], "error": f"unknown handle {msg['handle']!r}"})
+            return
+        self.send_am(TAG_GET_ANS, src, {"req": msg["req"], "data": buf})
+
+    def _on_get_ans(self, src: int, msg: dict) -> None:
+        with self._get_lock:
+            cb = self._pending_gets.pop(msg["req"], None)
+        if cb is None:
+            return
+        if "error" in msg:
+            # loud protocol error; the successor stays unreleased rather
+            # than silently running on absent data
+            debug.error("rank %d: GET %s failed at rank %d: %s",
+                        self.rank, msg["req"], src, msg["error"])
+            return
+        self.stats["get_bytes"] += getattr(msg["data"], "nbytes", 0)
+        cb(msg["data"])
+
+    # -- barrier (central, AM-based) -------------------------------------
+    def barrier(self) -> None:
+        if self.nranks == 1:
+            return
+        with self._barrier_cv:
+            self._barrier_epoch += 1
+            epoch = self._barrier_epoch
+        if self.rank == 0:
+            self._on_barrier(0, {"epoch": epoch, "phase": "enter"})
+        else:
+            self.send_am(TAG_BARRIER, 0, {"epoch": epoch, "phase": "enter"})
+        with self._barrier_cv:
+            while self._barrier_state.get(("released", epoch)) is None:
+                self._barrier_cv.wait(timeout=1.0)
+            self._barrier_state.pop(("released", epoch))
+
+    def _on_barrier(self, src: int, msg: dict) -> None:
+        epoch, phase = msg["epoch"], msg["phase"]
+        with self._barrier_cv:
+            if phase == "enter":  # only rank 0 sees these
+                n = self._barrier_state.get(("count", epoch), 0) + 1
+                self._barrier_state[("count", epoch)] = n
+                if n == self.nranks:
+                    self._barrier_state.pop(("count", epoch))
+                    for r in range(1, self.nranks):
+                        self._cmds.put((r, TAG_BARRIER,
+                                        {"epoch": epoch, "phase": "release"}))
+                    try:
+                        self._wake_w.send(b"\0")
+                    except (BlockingIOError, OSError):
+                        pass
+                    self._barrier_state[("released", epoch)] = True
+                    self._barrier_cv.notify_all()
+            else:  # release
+                self._barrier_state[("released", epoch)] = True
+                self._barrier_cv.notify_all()
+
+    # -- comm thread -----------------------------------------------------
+    def _comm_main(self) -> None:
+        """The funnelled progress loop (reference
+        ``remote_dep_dequeue_main`` → ``…nothread_progress``)."""
+        while not self._closing.is_set():
+            sent = self._drain_cmds()
+            got = self._poll_incoming(0.0 if sent else 0.05)
+            if (sent or got) and self.context is not None:
+                self.context._notify_work()
+
+    def _drain_cmds(self) -> int:
+        """Drain the command queue, aggregating per peer into one frame
+        (reference per-peer rings, remote_dep_mpi.c:1095-1132)."""
+        batches: Dict[int, List[Tuple[int, Any]]] = collections.defaultdict(list)
+        n = 0
+        while True:
+            try:
+                dst, tag, payload = self._cmds.get_nowait()
+            except queue.Empty:
+                break
+            batches[dst].append((tag, payload))
+            n += 1
+        for dst, batch in batches.items():
+            blob = pickle.dumps((self.rank, batch), protocol=5)
+            self.stats["am_bytes"] += len(blob)
+            self.stats["frames_sent"] += 1
+            sock = self._socks.get(dst)
+            if sock is None:
+                debug.error("rank %d: no route to rank %d", self.rank, dst)
+                continue
+            try:
+                # byte-tracked send: sendall on a non-blocking socket can
+                # transmit part of the frame before raising, with no way to
+                # learn how much — that would corrupt the length-prefixed
+                # stream on retry, so every send goes through the tracker
+                self._send_tracked(sock, _LEN.pack(len(blob)) + blob)
+            except OSError as e:
+                if not self._closing.is_set():
+                    debug.error("rank %d: send to %d failed: %s", self.rank, dst, e)
+        return n
+
+    def _send_tracked(self, sock: socket.socket, data: bytes) -> None:
+        view = memoryview(data)
+        while view and not self._closing.is_set():
+            try:
+                sent = sock.send(view)
+                view = view[sent:]
+            except (BlockingIOError, InterruptedError):
+                select.select([], [sock], [], 0.1)
+
+    def _poll_incoming(self, timeout: float) -> int:
+        rlist = list(self._socks.values()) + [self._wake_r]
+        try:
+            ready, _, _ = select.select(rlist, [], [], timeout)
+        except OSError:
+            return 0
+        n = 0
+        for sock in ready:
+            if sock is self._wake_r:
+                try:
+                    while sock.recv(4096):
+                        pass
+                except (BlockingIOError, OSError):
+                    pass
+                continue
+            peer = next((r for r, s in self._socks.items() if s is sock), None)
+            if peer is None:
+                continue
+            try:
+                data = sock.recv(1 << 20)
+            except (BlockingIOError, InterruptedError):
+                continue
+            except OSError:
+                data = b""
+            if not data:
+                if not self._closing.is_set():
+                    debug.verbose(2, "comm", "rank %d: peer %d closed", self.rank, peer)
+                self._socks.pop(peer, None)
+                continue
+            buf = self._rdbuf[peer]
+            buf += data
+            while len(buf) >= _LEN.size:
+                (length,) = _LEN.unpack_from(buf, 0)
+                if len(buf) < _LEN.size + length:
+                    break
+                blob = bytes(buf[_LEN.size:_LEN.size + length])
+                del buf[:_LEN.size + length]
+                src, batch = pickle.loads(blob)
+                for tag, payload in batch:
+                    self._dispatch(tag, src, payload)
+                    n += 1
+        return n
+
+    def _dispatch(self, tag: int, src: int, payload: Any) -> None:
+        with self._am_lock:
+            cb = self._am.get(tag)
+            if cb is None:
+                self._unclaimed[tag].append((src, payload))
+                return
+        self.stats[f"am_recv_{tag}"] += 1
+        try:
+            cb(src, payload)
+        except Exception as e:
+            debug.error("rank %d: AM callback tag %d raised: %s", self.rank, tag, e)
+            import traceback
+
+            traceback.print_exc()
+
+    # -- CE vtable misc ---------------------------------------------------
+    def progress_nonblocking(self) -> int:
+        # a dedicated comm thread owns the sockets; workers have nothing
+        # to drive (reference multi-node mode: comm thread does it all)
+        return 0
+
+    def detach_context(self, context) -> None:
+        self.close()
+
+    def close(self) -> None:
+        if self._closing.is_set():
+            return
+        self._closing.set()
+        try:
+            self._wake_w.send(b"\0")
+        except OSError:
+            pass
+        self._thread.join(timeout=5.0)
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def endpoint_from_env() -> TCPComm:
+    """Build this process's endpoint from the launcher environment
+    (``PARSEC_TPU_RANK`` / ``_NRANKS`` / ``_RDV`` or ``_PEERS``)."""
+    rank = int(os.environ["PARSEC_TPU_RANK"])
+    nranks = int(os.environ["PARSEC_TPU_NRANKS"])
+    peers = os.environ.get("PARSEC_TPU_PEERS")
+    return TCPComm(
+        rank, nranks,
+        rendezvous_dir=os.environ.get("PARSEC_TPU_RDV"),
+        peers=peers.split(",") if peers else None,
+    )

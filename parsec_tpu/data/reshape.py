@@ -190,9 +190,11 @@ class ReshapeSpec:
 
 
 # promise cache: (data_id, spec) -> (future, reshaped Data); entries are
-# evicted when the source Data is garbage-collected (weakref.finalize)
+# evicted when the source Data is garbage-collected (weakref.finalize).
+# RLock: the finalizer can fire from a GC pass triggered by an allocation
+# made while get_copy_reshape already holds the lock on the same thread.
 _promises: Dict[Tuple[int, ReshapeSpec], Tuple[DataCopyFuture, Data]] = {}
-_promises_lock = threading.Lock()
+_promises_lock = threading.RLock()
 _finalized: set = set()
 
 

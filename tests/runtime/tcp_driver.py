@@ -1,0 +1,143 @@
+"""Per-rank driver for the TCP backend tests (run as a subprocess per rank
+by test_tcp.py; scenario name in argv[1]).  Prints one JSON line of
+per-rank results on success; any assertion failure exits nonzero.
+"""
+
+import faulthandler
+import json
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+# kill -USR1 <pid> dumps all thread stacks to /tmp/tcpdrv_<pid>.stacks
+_fh = open(f"/tmp/tcpdrv_{os.getpid()}.stacks", "w")
+faulthandler.register(signal.SIGUSR1, file=_fh)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# CPU device only: the parent test process may hold the (exclusive) TPU
+# tunnel; a child touching jax.devices() would block on the backend client
+os.environ.setdefault("PARSEC_MCA_device_enabled", "cpu")
+
+from parsec_tpu import Context  # noqa: E402
+from parsec_tpu.comm import endpoint_from_env  # noqa: E402
+from parsec_tpu.comm.engine import TAG_USER_BASE  # noqa: E402
+from parsec_tpu.data import LocalCollection  # noqa: E402
+from parsec_tpu.dsl.ptg import PTG, IN, INOUT  # noqa: E402
+
+
+def scenario_smoke(ce):
+    """AM echo, aggregation, one-sided get, barrier — pure CE layer."""
+    got = []
+    ce.register_am(TAG_USER_BASE, lambda src, p: got.append((src, p)))
+    ce.barrier()
+    # every rank sends 3 AMs to every other rank (exercises per-peer batching)
+    for dst in range(ce.nranks):
+        if dst != ce.rank:
+            for i in range(3):
+                ce.send_am(TAG_USER_BASE, dst, {"from": ce.rank, "i": i})
+    deadline = time.time() + 30
+    while len(got) < 3 * (ce.nranks - 1):
+        time.sleep(0.005)
+        assert time.time() < deadline, f"only {len(got)} AMs arrived"
+    assert sorted(p["i"] for _, p in got) == sorted(list(range(3)) * (ce.nranks - 1))
+
+    # one-sided get of a large registered buffer
+    payload = np.arange(65536, dtype=np.float64) + ce.rank
+    ce.mem_register(("blk", ce.rank), payload)
+    ce.barrier()
+    pulled = []
+    src = (ce.rank + 1) % ce.nranks
+    ce.get(src, ("blk", src), lambda buf: pulled.append(buf))
+    deadline = time.time() + 30
+    while not pulled:
+        time.sleep(0.005)
+        assert time.time() < deadline, "get never completed"
+    np.testing.assert_allclose(pulled[0], np.arange(65536, dtype=np.float64) + src)
+    ce.barrier()
+    return {"ams": len(got), "get_bytes": int(ce.stats["get_bytes"])}
+
+
+def scenario_ptg_chain(ce):
+    """Cross-rank PTG chain: every dependency crosses the real wire."""
+    n = 12
+    seen = []
+    ctx = Context(nb_cores=2, rank=ce.rank, nranks=ce.nranks, comm=ce)
+    dc = LocalCollection("D", shape=(n,), nodes=ce.nranks, myrank=ce.rank,
+                         init=lambda k: np.zeros(4))
+    dc.rank_of = lambda *key: dc.data_key(*key) % ce.nranks
+
+    ptg = PTG("chain")
+    step = ptg.task_class("step", k="0 .. N-1")
+    step.affinity("D(k)")
+    step.flow("X", INOUT,
+              "<- (k == 0) ? D(0) : X step(k-1)",
+              "-> (k < N-1) ? X step(k+1) : D(k)")
+
+    def body(X, k):
+        seen.append(k)
+        X += 1.0
+
+    step.body(cpu=body)
+    tp = ptg.taskpool(N=n, D=dc)
+    ctx.add_taskpool(tp)
+    ok = tp.wait(timeout=90)
+    assert ok, "taskpool did not quiesce"
+    assert seen == list(range(ce.rank, n, ce.nranks)), seen
+    # final value: D(n-1) on its owner holds n increments
+    if dc.rank_of(n - 1) == ce.rank:
+        final = dc.data_of(n - 1).newest_copy().payload
+        np.testing.assert_allclose(final, np.full(4, float(n)))
+    ce.barrier()
+    ctx.fini()
+    return {"seen": seen}
+
+
+def scenario_ptg_bigpayload(ce):
+    """Broadcast with a payload above the short limit → GET path on wire."""
+    from parsec_tpu.utils import mca_param
+
+    mca_param.set_param("runtime", "comm_short_limit", 64)
+    got = []
+    ctx = Context(nb_cores=2, rank=ce.rank, nranks=ce.nranks, comm=ce)
+    dc = LocalCollection("D", shape=(8,), nodes=ce.nranks, myrank=ce.rank,
+                         init=lambda k: np.arange(1024.0))
+    dc.rank_of = lambda *key: dc.data_key(*key) % ce.nranks
+
+    ptg = PTG("big")
+    src = ptg.task_class("src")
+    src.affinity("D(0)")
+    src.flow("X", INOUT, "<- D(0)", "-> X sink(0 .. NR-1)")
+    src.body(cpu=lambda X: X.__imul__(3.0))
+    sink = ptg.task_class("sink", r="0 .. NR-1")
+    sink.affinity("D(r)")
+    sink.flow("X", IN, "<- X src()")
+    sink.body(cpu=lambda X, r: got.append(X.copy()))
+    tp = ptg.taskpool(NR=ce.nranks, D=dc)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=90)
+    # the sink on THIS rank saw the producer's value
+    mine = [g for g in got]
+    assert len(mine) == 1, f"expected 1 local sink, got {len(mine)}"
+    np.testing.assert_allclose(mine[0], np.arange(1024.0) * 3.0)
+    stats = dict(rank=ce.rank,
+                 get_issued=int(ctx.comm.remote_dep.stats["get_issued"]))
+    if ce.rank != 0:
+        assert stats["get_issued"] >= 1, "big payload should use GET path"
+    ce.barrier()
+    ctx.fini()
+    return stats
+
+
+def main():
+    scenario = sys.argv[1]
+    ce = endpoint_from_env()
+    fn = globals()[f"scenario_{scenario}"]
+    out = fn(ce)
+    ce.close()
+    print(json.dumps({"rank": ce.rank, "ok": True, **(out or {})}))
+
+
+if __name__ == "__main__":
+    main()

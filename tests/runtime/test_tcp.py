@@ -1,0 +1,52 @@
+"""TCP backend tests: real multi-PROCESS ranks over sockets (the reference
+tests "multi-node" as mpiexec multi-process on one node, SURVEY.md §4 —
+this is the same shape with our launcher instead of mpiexec).
+
+Each test spawns N subprocesses running tcp_driver.py scenarios; the
+scenarios self-check and print a JSON result line.
+"""
+
+import json
+import os
+
+import pytest
+
+from parsec_tpu.comm.launch import launch
+
+DRIVER = os.path.join(os.path.dirname(__file__), "tcp_driver.py")
+
+
+def run_scenario(name, nranks, timeout=180):
+    results = launch(nranks, [DRIVER, name], timeout=timeout,
+                     env={"JAX_PLATFORMS": "cpu"})
+    out = []
+    for r in results:
+        line = r.stdout.strip().splitlines()[-1]
+        out.append(json.loads(line))
+    assert all(o["ok"] for o in out)
+    return out
+
+
+def test_tcp_smoke_2ranks():
+    """AM batching, one-sided GET, barrier across 2 processes."""
+    out = run_scenario("smoke", 2)
+    assert all(o["ams"] == 3 for o in out)
+    assert all(o["get_bytes"] == 65536 * 8 for o in out)
+
+
+def test_tcp_smoke_4ranks():
+    out = run_scenario("smoke", 4)
+    assert all(o["ams"] == 9 for o in out)
+
+
+def test_tcp_ptg_chain_2ranks():
+    """Cross-process PTG chain: every dependency over the real wire."""
+    out = run_scenario("ptg_chain", 2)
+    ks = sorted(k for o in out for k in o["seen"])
+    assert ks == list(range(12))
+
+
+def test_tcp_ptg_bigpayload_get():
+    """Above-short-limit payloads use the one-sided GET handshake."""
+    out = run_scenario("ptg_bigpayload", 2)
+    assert any(o["get_issued"] >= 1 for o in out if o["rank"] != 0)
