@@ -47,11 +47,18 @@ def launch(
             [python or sys.executable, *argv],
             env=child_env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True))
+    import time as _time
+
+    deadline = _time.monotonic() + timeout  # one job-wide deadline, not per rank
     results = []
     failed = []
     for r, p in enumerate(procs):
+        if failed:  # a failed rank dooms the collective job; reap the rest fast
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
         try:
-            out, err = p.communicate(timeout=timeout)
+            out, err = p.communicate(timeout=max(0.1, deadline - _time.monotonic()))
         except subprocess.TimeoutExpired:
             p.kill()
             out, err = p.communicate()

@@ -131,7 +131,12 @@ class TCPComm(CommEngine):
                    host: str, timeout: float) -> None:
         lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        lsock.bind((host, 0))
+        if peers is not None:
+            # explicit peer list: bind the port this rank advertises
+            my_host, my_port_s = peers[self.rank].rsplit(":", 1)
+            lsock.bind((my_host, int(my_port_s)))
+        else:
+            lsock.bind((host, 0))
         lsock.listen(self.nranks)
         my_port = lsock.getsockname()[1]
 
@@ -265,6 +270,11 @@ class TCPComm(CommEngine):
             self.send_am(TAG_BARRIER, 0, {"epoch": epoch, "phase": "enter"})
         with self._barrier_cv:
             while self._barrier_state.get(("released", epoch)) is None:
+                if self._closing.is_set():
+                    raise RuntimeError("comm engine closed while in barrier")
+                if len(self._socks) < self.nranks - 1:
+                    lost = set(range(self.nranks)) - set(self._socks) - {self.rank}
+                    raise RuntimeError(f"peer rank(s) {sorted(lost)} lost in barrier")
                 self._barrier_cv.wait(timeout=1.0)
             self._barrier_state.pop(("released", epoch))
 
@@ -337,7 +347,12 @@ class TCPComm(CommEngine):
                 sent = sock.send(view)
                 view = view[sent:]
             except (BlockingIOError, InterruptedError):
-                select.select([], [sock], [], 0.1)
+                # the peer may be blocked sending to US (mutual large
+                # frames); keep draining incoming traffic while waiting
+                # for writability, or both comm threads deadlock with
+                # full kernel buffers
+                self._poll_incoming(0.0)
+                select.select([], [sock], [], 0.05)
 
     def _poll_incoming(self, timeout: float) -> int:
         rlist = list(self._socks.values()) + [self._wake_r]

@@ -6,6 +6,7 @@ The TPU-native counterpart of the reference's multi-rank execution — see
 
 from .mesh import best_grid, block_sharding, make_mesh, replicated
 from . import collectives
+from .ring_attention import attention_reference, ring_attention, ulysses_attention
 from .spmd import ring_gemm, spmd_cholesky, summa_gemm
 
 __all__ = [
@@ -17,4 +18,7 @@ __all__ = [
     "spmd_cholesky",
     "summa_gemm",
     "ring_gemm",
+    "ring_attention",
+    "ulysses_attention",
+    "attention_reference",
 ]

@@ -130,7 +130,9 @@ def ring_gemm(A: jax.Array, B: jax.Array, mesh: Mesh, axis: Optional[str] = None
             b = lax.ppermute(b, axis, [(i, (i - 1) % R) for i in range(R)])
             return (c, b)
 
-        c0 = lax.pvary(jnp.zeros((a_blk.shape[0], b_blk.shape[1]), A.dtype), (axis,))
+        from .ring_attention import _varying
+
+        c0 = _varying(jnp.zeros((a_blk.shape[0], b_blk.shape[1]), A.dtype), axis)
         c, _ = lax.fori_loop(0, R, step, (c0, b_blk))
         return c
 
