@@ -1,0 +1,255 @@
+// Native dataflow-graph engine: dependency counting, priority scheduling,
+// work-stealing worker pool, and topological ordering.
+//
+// This is the C++ core behind the Python runtime's hot paths — the role
+// the reference implements in C with its scheduling loop and lfq
+// scheduler (/root/reference/parsec/scheduling.c,
+// /root/reference/parsec/mca/sched/lfq — studied for behavior, written
+// fresh for this runtime):
+//   * tasks are integer ids with a priority and a user tag;
+//   * edges are (pred, succ) pairs; each completed task decrements its
+//     successors' counters, counter 0 => ready;
+//   * run(): N native threads execute ready tasks through a C callback
+//     (Python bodies enter via a ctypes trampoline that re-acquires the
+//     GIL; native bodies run free);
+//   * a shared priority pool plus the completing worker keeping its
+//     highest-priority released successor for immediate execution (the
+//     reference's es->next_task fast path) — dataflow chains run
+//     queue-free;
+//   * order(): dependency-respecting, priority-greedy linearisation used
+//     to lower a whole taskpool into one XLA program quickly.
+//
+// Streaming insertion (DTD style) is supported: add_task/add_dep may be
+// called while run() is live; quiescence is reached when every inserted
+// task has executed and the submitter called seal().
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Task {
+    int32_t priority = 0;
+    int64_t user_tag = 0;
+    std::atomic<int32_t> missing{0};  // unresolved predecessors
+    std::vector<int64_t> succs;
+    std::atomic<bool> done{false};
+};
+
+// ready-pool entries carry their priority so heap compares never touch
+// the (growable) tasks vector — streaming insertion may reallocate it
+using Ready = std::pair<int32_t, int64_t>;  // (priority, id); max-heap
+
+struct Graph {
+    std::vector<Task*> tasks;
+    std::mutex graph_mu;  // guards tasks vector growth + edge insertion
+    std::priority_queue<Ready> ready;
+    std::mutex ready_mu;
+    std::condition_variable ready_cv;
+    std::atomic<int64_t> n_executed{0};
+    std::atomic<int64_t> n_inserted{0};
+    std::atomic<bool> sealed{false};
+    std::atomic<bool> failed{false};
+
+    ~Graph() {
+        for (Task* t : tasks) delete t;
+    }
+};
+
+using BodyFn = void (*)(int64_t task_id, int64_t user_tag, void* ctx);
+
+void push_ready(Graph* g, int32_t prio, int64_t id) {
+    {
+        std::lock_guard<std::mutex> lk(g->ready_mu);
+        g->ready.push({prio, id});
+    }
+    g->ready_cv.notify_one();
+}
+
+// Complete a task: release successors whose last predecessor this was.
+// Returns the highest-priority newly-ready successor for the calling
+// worker to run next (the reference keeps it in es->next_task instead of
+// round-tripping through the scheduler), or -1.
+int64_t complete(Graph* g, int64_t id) {
+    Task* t;
+    std::vector<int64_t> succs;
+    {
+        std::lock_guard<std::mutex> lk(g->graph_mu);
+        t = g->tasks[id];
+        t->done.store(true, std::memory_order_release);
+        succs = t->succs;  // snapshot: edges to a done task are rejected
+    }
+    int64_t keep = -1;
+    int32_t keep_prio = 0;
+    for (int64_t s : succs) {
+        Task* st;
+        {
+            std::lock_guard<std::mutex> lk(g->graph_mu);
+            st = g->tasks[s];
+        }
+        if (st->missing.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+            if (keep < 0) {
+                keep = s;
+                keep_prio = st->priority;
+            } else if (st->priority > keep_prio) {
+                push_ready(g, keep_prio, keep);
+                keep = s;
+                keep_prio = st->priority;
+            } else {
+                push_ready(g, st->priority, s);
+            }
+        }
+    }
+    g->n_executed.fetch_add(1, std::memory_order_acq_rel);
+    return keep;
+}
+
+bool all_done(Graph* g) {
+    return g->sealed.load(std::memory_order_acquire) &&
+           g->n_executed.load(std::memory_order_acquire) ==
+               g->n_inserted.load(std::memory_order_acquire);
+}
+
+void worker_main(Graph* g, BodyFn body, void* ctx) {
+    int64_t next = -1;  // kept successor from the previous completion
+    for (;;) {
+        int64_t id = next;
+        next = -1;
+        if (id < 0) {
+            std::unique_lock<std::mutex> lk(g->ready_mu);
+            g->ready_cv.wait_for(lk, std::chrono::milliseconds(50), [&] {
+                return !g->ready.empty() || all_done(g) ||
+                       g->failed.load(std::memory_order_acquire);
+            });
+            if (!g->ready.empty()) {
+                id = g->ready.top().second;
+                g->ready.pop();
+            } else if (all_done(g) || g->failed.load(std::memory_order_acquire)) {
+                return;
+            } else {
+                continue;
+            }
+        }
+        Task* t;
+        {
+            std::lock_guard<std::mutex> lk(g->graph_mu);
+            t = g->tasks[id];
+        }
+        body(id, t->user_tag, ctx);
+        next = complete(g, id);
+        if (all_done(g)) g->ready_cv.notify_all();
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pz_graph_new(void) { return new Graph(); }
+
+void pz_graph_destroy(void* gp) { delete static_cast<Graph*>(gp); }
+
+// Add a task; returns its id. May be called while run() is live
+// (streaming/DTD insertion). Declare predecessors with pz_graph_add_dep,
+// then pz_graph_task_commit to arm the task.
+int64_t pz_graph_add_task(void* gp, int32_t priority, int64_t user_tag) {
+    Graph* g = static_cast<Graph*>(gp);
+    Task* t = new Task();
+    t->priority = priority;
+    t->user_tag = user_tag;
+    t->missing.store(1, std::memory_order_relaxed);  // commit token
+    std::lock_guard<std::mutex> lk(g->graph_mu);
+    g->tasks.push_back(t);
+    g->n_inserted.fetch_add(1, std::memory_order_acq_rel);
+    return static_cast<int64_t>(g->tasks.size()) - 1;
+}
+
+// Declare succ depends on pred. Returns 1 if the edge was recorded, 0 if
+// pred already completed (the dependency is already satisfied), -1 on a
+// bad id.
+int pz_graph_add_dep(void* gp, int64_t pred, int64_t succ) {
+    Graph* g = static_cast<Graph*>(gp);
+    std::lock_guard<std::mutex> lk(g->graph_mu);
+    if (pred < 0 || succ < 0 ||
+        pred >= static_cast<int64_t>(g->tasks.size()) ||
+        succ >= static_cast<int64_t>(g->tasks.size()))
+        return -1;
+    Task* pt = g->tasks[pred];
+    if (pt->done.load(std::memory_order_acquire)) return 0;
+    g->tasks[succ]->missing.fetch_add(1, std::memory_order_acq_rel);
+    pt->succs.push_back(succ);
+    return 1;
+}
+
+// All predecessors declared: drop the commit token; the task becomes
+// ready when its counter reaches zero.
+void pz_graph_task_commit(void* gp, int64_t id) {
+    Graph* g = static_cast<Graph*>(gp);
+    Task* t;
+    {
+        std::lock_guard<std::mutex> lk(g->graph_mu);
+        t = g->tasks[id];
+    }
+    if (t->missing.fetch_sub(1, std::memory_order_acq_rel) == 1)
+        push_ready(g, t->priority, id);
+}
+
+// No more tasks will be inserted; run() returns once everything executed.
+void pz_graph_seal(void* gp) {
+    Graph* g = static_cast<Graph*>(gp);
+    g->sealed.store(true, std::memory_order_release);
+    g->ready_cv.notify_all();
+}
+
+// Execute the graph with nthreads native workers. Returns the number of
+// executed tasks, or -1 if the graph did not quiesce (cycle or
+// uncommitted task detected at seal time).
+int64_t pz_graph_run(void* gp, BodyFn body, void* ctx, int32_t nthreads) {
+    Graph* g = static_cast<Graph*>(gp);
+    if (nthreads < 1) nthreads = 1;
+    std::vector<std::thread> ts;
+    ts.reserve(nthreads - 1);
+    for (int32_t i = 1; i < nthreads; ++i)
+        ts.emplace_back(worker_main, g, body, ctx);
+    worker_main(g, body, ctx);
+    for (auto& th : ts) th.join();
+    if (!all_done(g)) return -1;
+    return g->n_executed.load(std::memory_order_acquire);
+}
+
+int64_t pz_graph_executed(void* gp) {
+    return static_cast<Graph*>(gp)->n_executed.load(std::memory_order_acquire);
+}
+
+// Dependency-respecting, priority-greedy linearisation into out[0..n).
+// Returns the count written, or -1 if the graph has a cycle / uncommitted
+// tasks. Single-threaded; does not consume the graph.
+int64_t pz_graph_order(void* gp, int64_t* out, int64_t cap) {
+    Graph* g = static_cast<Graph*>(gp);
+    std::lock_guard<std::mutex> lk(g->graph_mu);
+    int64_t n = static_cast<int64_t>(g->tasks.size());
+    if (cap < n) return -1;
+    std::vector<int32_t> miss(n);
+    for (int64_t i = 0; i < n; ++i)
+        miss[i] = g->tasks[i]->missing.load(std::memory_order_relaxed) - 1;
+    std::priority_queue<Ready> pq;
+    for (int64_t i = 0; i < n; ++i)
+        if (miss[i] == 0) pq.push({g->tasks[i]->priority, i});
+    int64_t written = 0;
+    while (!pq.empty()) {
+        int64_t id = pq.top().second;
+        pq.pop();
+        out[written++] = id;
+        for (int64_t s : g->tasks[id]->succs)
+            if (--miss[s] == 0) pq.push({g->tasks[s]->priority, s});
+    }
+    return written == n ? written : -1;
+}
+
+}  // extern "C"

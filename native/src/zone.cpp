@@ -1,0 +1,124 @@
+// Zone allocator: first-fit segment allocator over one preallocated slab,
+// with free-list coalescing. The TPU runtime uses it to manage tile
+// residency inside a fixed HBM budget (the byte-space analog of the
+// reference's GPU slab allocator, /root/reference/parsec/utils/zone_malloc.c
+// — re-designed: offsets instead of pointers, because the managed space is
+// device HBM that host code never dereferences; PJRT owns the real memory).
+//
+// Thread-safe: one mutex per zone (allocation is never on the task hot
+// path — stage-in only).
+
+#include <cstdint>
+#include <cstddef>
+#include <map>
+#include <mutex>
+#include <new>
+
+namespace {
+
+struct Zone {
+    size_t capacity;
+    size_t used;
+    // free segments: offset -> length (ordered, coalescible)
+    std::map<int64_t, int64_t> free_segs;
+    // live allocations: offset -> length
+    std::map<int64_t, int64_t> live;
+    std::mutex mu;
+
+    explicit Zone(size_t cap) : capacity(cap), used(0) {
+        free_segs[0] = static_cast<int64_t>(cap);
+    }
+};
+
+int64_t align_up(int64_t v, int64_t a) { return (v + a - 1) & ~(a - 1); }
+
+}  // namespace
+
+extern "C" {
+
+void* pz_zone_new(size_t bytes) {
+    return new (std::nothrow) Zone(bytes);
+}
+
+void pz_zone_destroy(void* zp) {
+    delete static_cast<Zone*>(zp);
+}
+
+// Returns the offset of a [bytes]-long segment aligned to [align]
+// (power of two), or -1 when no segment fits.
+int64_t pz_zone_alloc(void* zp, size_t bytes, size_t align) {
+    Zone* z = static_cast<Zone*>(zp);
+    if (bytes == 0) return -1;
+    if (align == 0) align = 1;
+    std::lock_guard<std::mutex> g(z->mu);
+    for (auto it = z->free_segs.begin(); it != z->free_segs.end(); ++it) {
+        int64_t off = it->first, len = it->second;
+        int64_t aoff = align_up(off, static_cast<int64_t>(align));
+        int64_t pad = aoff - off;
+        if (len - pad < static_cast<int64_t>(bytes)) continue;
+        // carve [aoff, aoff+bytes) out of the segment
+        z->free_segs.erase(it);
+        if (pad > 0) z->free_segs[off] = pad;
+        int64_t rest = len - pad - static_cast<int64_t>(bytes);
+        if (rest > 0) z->free_segs[aoff + static_cast<int64_t>(bytes)] = rest;
+        z->live[aoff] = static_cast<int64_t>(bytes);
+        z->used += bytes;
+        return aoff;
+    }
+    return -1;
+}
+
+// Frees a previously returned offset; coalesces with neighbours.
+// Returns 0 on success, -1 for an unknown offset.
+int pz_zone_release(void* zp, int64_t off) {
+    Zone* z = static_cast<Zone*>(zp);
+    std::lock_guard<std::mutex> g(z->mu);
+    auto lit = z->live.find(off);
+    if (lit == z->live.end()) return -1;
+    int64_t len = lit->second;
+    z->live.erase(lit);
+    z->used -= static_cast<size_t>(len);
+    auto next = z->free_segs.lower_bound(off);
+    // coalesce with following segment
+    if (next != z->free_segs.end() && next->first == off + len) {
+        len += next->second;
+        next = z->free_segs.erase(next);
+    }
+    // coalesce with preceding segment
+    if (next != z->free_segs.begin()) {
+        auto prev = std::prev(next);
+        if (prev->first + prev->second == off) {
+            prev->second += len;
+            return 0;
+        }
+    }
+    z->free_segs[off] = len;
+    return 0;
+}
+
+size_t pz_zone_used(void* zp) {
+    Zone* z = static_cast<Zone*>(zp);
+    std::lock_guard<std::mutex> g(z->mu);
+    return z->used;
+}
+
+size_t pz_zone_capacity(void* zp) {
+    return static_cast<Zone*>(zp)->capacity;
+}
+
+int64_t pz_zone_largest_free(void* zp) {
+    Zone* z = static_cast<Zone*>(zp);
+    std::lock_guard<std::mutex> g(z->mu);
+    int64_t best = 0;
+    for (auto& kv : z->free_segs)
+        if (kv.second > best) best = kv.second;
+    return best;
+}
+
+int64_t pz_zone_num_live(void* zp) {
+    Zone* z = static_cast<Zone*>(zp);
+    std::lock_guard<std::mutex> g(z->mu);
+    return static_cast<int64_t>(z->live.size());
+}
+
+}  // extern "C"

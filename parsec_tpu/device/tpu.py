@@ -124,6 +124,44 @@ class TpuDevice(Device):
         self._lru_clean: "collections.OrderedDict[int, Data]" = collections.OrderedDict()
         self._lru_dirty: "collections.OrderedDict[int, Data]" = collections.OrderedDict()
         self._jit_cache: Dict[Any, Any] = {}
+        #: native zone allocator models HBM segments (alignment +
+        #: fragmentation) inside the budget — the reference's zone_malloc
+        #: slab, offset-based since PJRT owns the real device memory
+        self._zone = None
+        self._offsets: Dict[int, Tuple[int, int]] = {}  # data_id -> (off, nbytes)
+        if mca_param.register("device", "tpu_native_zone", 1,
+                              help="use the native zone allocator for HBM accounting"):
+            try:
+                from .. import native
+
+                if native.available():
+                    self._zone = native.ZoneAllocator(self.hbm_budget)
+            except Exception:
+                self._zone = None
+
+    @property
+    def hbm_budget(self) -> int:
+        return self._hbm_budget
+
+    @hbm_budget.setter
+    def hbm_budget(self, value: int) -> None:
+        """Budget changes rebuild the zone, migrating live residency slots
+        (slots that no longer fit fall out of segment accounting)."""
+        self._hbm_budget = int(value)
+        if getattr(self, "_zone", None) is None:
+            return
+        from .. import native
+
+        fresh = native.ZoneAllocator(self._hbm_budget)
+        migrated: Dict[int, Tuple[int, int]] = {}
+        for did, (_off, nb) in self._offsets.items():
+            noff = fresh.alloc(nb)
+            if noff is not None:
+                migrated[did] = (noff, nb)
+        self._zone.close()
+        self._zone = fresh
+        self._offsets = migrated
+        self.hbm_used = fresh.used
 
     # ------------------------------------------------------------------
     # entry point from the scheduling core (chore hook delegates here)
@@ -253,11 +291,10 @@ class TpuDevice(Device):
         host = np.asarray(newest.payload)
         # re-staging over a stale device copy replaces it: account the delta
         old = mine.nbytes if (mine is not None and mine.payload is not None) else 0
-        self._reserve(max(0, host.nbytes - old))
+        self._hbm_realloc(data, old, host.nbytes)
         arr = jax.device_put(host, self.jdev)
         c = data.attach_copy(self.data_index, arr)
         c.version = newest.version
-        self.hbm_used += host.nbytes - old
         self.stats["bytes_in"] += host.nbytes
         self._lru_touch(data, dirty=False)
         return arr
@@ -271,20 +308,60 @@ class TpuDevice(Device):
         guard = 0
         while self.hbm_used + nbytes > self.hbm_budget and guard < 10000:
             guard += 1
-            if self._lru_clean:
-                _, victim = self._lru_clean.popitem(last=False)
-                self._drop_copy(victim)
-            elif self._lru_dirty:
-                _, victim = self._lru_dirty.popitem(last=False)
-                self._writeback(victim)
-                self._drop_copy(victim)
-            else:
+            if not self._evict_one():
                 break  # nothing evictable; trust the PJRT allocator
+
+    def _evict_one(self) -> bool:
+        if self._lru_clean:
+            _, victim = self._lru_clean.popitem(last=False)
+            self._drop_copy(victim)
+            return True
+        if self._lru_dirty:
+            _, victim = self._lru_dirty.popitem(last=False)
+            self._writeback(victim)
+            self._drop_copy(victim)
+            return True
+        return False
+
+    def _hbm_realloc(self, data: Data, old_nbytes: int, new_nbytes: int) -> None:
+        """(Re)account ``data``'s residency slot, evicting for space. With
+        the native zone, alignment + fragmentation are modelled for real:
+        an allocation can fail even under budget and trigger eviction."""
+        # the allocatee must not be its own eviction victim (either mode):
+        # callers re-touch the LRU right after accounting
+        self._lru_clean.pop(data.data_id, None)
+        self._lru_dirty.pop(data.data_id, None)
+        if self._zone is not None:
+            slot = self._offsets.pop(data.data_id, None)
+            if slot is not None:
+                self._zone.release(slot[0])
+            if new_nbytes > 0:
+                guard = 0
+                while True:
+                    off = self._zone.alloc(new_nbytes)
+                    if off is not None or guard > 10000 or not self._evict_one():
+                        break
+                    guard += 1
+                if off is not None:
+                    self._offsets[data.data_id] = (off, new_nbytes)
+            self.hbm_used = self._zone.used
+        else:
+            self._reserve(max(0, new_nbytes - old_nbytes))
+            self.hbm_used += new_nbytes - old_nbytes
+
+    def _hbm_free(self, data: Data, nbytes: int) -> None:
+        if self._zone is not None:
+            slot = self._offsets.pop(data.data_id, None)
+            if slot is not None:
+                self._zone.release(slot[0])
+            self.hbm_used = self._zone.used
+        else:
+            self.hbm_used -= nbytes
 
     def _drop_copy(self, data: Data) -> None:
         c = data.detach_copy(self.data_index)
         if c is not None:
-            self.hbm_used -= c.nbytes
+            self._hbm_free(data, c.nbytes)
             self.stats["evictions"] += 1
 
     def _writeback(self, data: Data) -> None:
@@ -334,11 +411,13 @@ class TpuDevice(Device):
                 c = data.attach_copy(self.data_index, arr)
             else:
                 c.payload = arr
-            self.hbm_used += arr.nbytes - old
+            self._hbm_realloc(data, old, arr.nbytes)
             data.version_bump(self.data_index)
             self._lru_touch(data, dirty=True)
-        # outputs grew residency: re-settle under the budget
-        self._reserve(0)
+        # outputs grew residency: re-settle under the budget (zone mode
+        # already evicted during allocation)
+        if self._zone is None:
+            self._reserve(0)
 
     # ------------------------------------------------------------------
     def resident_data(self, task: Task) -> int:

@@ -63,24 +63,58 @@ class TaskGraph:
         return [s for (_f, s, _sf) in self.nodes[tid].out_edges]
 
     def topo_order(self) -> List[TaskId]:
-        """Kahn topological order, priority-aware among ready nodes."""
+        """Kahn topological order, priority-aware among ready nodes.
+        Large DAGs run through the native C++ engine when available."""
+        try:
+            from .. import native
+
+            if native.available() and len(self.nodes) > 256:
+                return self._topo_order_native(native)
+        except Exception:
+            pass
+        import heapq
+
         indeg = {tid: n.in_edges for tid, n in self.nodes.items()}
-        ready = [tid for tid, d in indeg.items() if d == 0]
+        seq = 0  # tie-break: insertion order keeps the heap deterministic
+        heap = []
+        for tid, d in indeg.items():
+            if d == 0:
+                heap.append((-self.nodes[tid].priority, seq, tid))
+                seq += 1
+        heapq.heapify(heap)
         out: List[TaskId] = []
-        while ready:
-            ready.sort(key=lambda t: -self.nodes[t].priority)
-            tid = ready.pop(0)
+        while heap:
+            _, _, tid = heapq.heappop(heap)
             out.append(tid)
             # in_edges (goal_of) counts one per declared dep instance, which
             # is exactly how out_edges are enumerated — decrement per edge
             for (_f, succ, _sf) in self.nodes[tid].out_edges:
                 indeg[succ] -= 1
                 if indeg[succ] == 0:
-                    ready.append(succ)
+                    heapq.heappush(heap, (-self.nodes[succ].priority, seq, succ))
+                    seq += 1
         if len(out) != len(self.nodes):
             stuck = [t for t, d in indeg.items() if d > 0]
             raise RuntimeError(f"task graph has a cycle or broken deps: stuck={stuck[:5]}")
         return out
+
+    def _topo_order_native(self, native) -> List[TaskId]:
+        g = native.NativeGraph()
+        tids = list(self.nodes)
+        index = {}
+        for i, tid in enumerate(tids):
+            index[tid] = g.add_task(priority=self.nodes[tid].priority)
+        for tid in tids:
+            me = index[tid]
+            for (_f, succ, _sf) in self.nodes[tid].out_edges:
+                g.add_dep(me, index[succ])
+        try:
+            order = g.order()
+        except RuntimeError as e:
+            raise RuntimeError(f"task graph has a cycle or broken deps: {e}") from e
+        finally:
+            g.close()
+        return [tids[i] for i in order]
 
 
 def capture(tp: PTGTaskpool, ranks: Optional[Iterable[int]] = None) -> TaskGraph:

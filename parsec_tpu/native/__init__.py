@@ -1,0 +1,256 @@
+"""Native C++ runtime core (ctypes bindings).
+
+The reference's runtime core is native C; this package provides the
+TPU framework's native core — a C++ shared library built on demand from
+``native/src/`` and bound via ctypes (no pybind11 in this image):
+
+* :class:`ZoneAllocator` — first-fit offset allocator with coalescing,
+  the HBM-budget manager behind the TPU device module (reference role:
+  ``parsec/utils/zone_malloc.c``; redesigned around offsets since PJRT
+  owns the actual device memory).
+* :class:`NativeGraph` — dependency-counting dataflow engine with a
+  priority pool, keep-next-task fast path, streaming (DTD-style)
+  insertion, native worker threads, and a fast priority-respecting
+  topological ``order()`` used for whole-DAG XLA lowering (reference
+  role: ``parsec/scheduling.c`` + ``mca/sched``).
+
+``available()`` reports whether the toolchain produced the library;
+every consumer has a pure-Python fallback path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, List, Optional, Sequence
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_SRC_DIR = os.path.join(_REPO, "native", "src")
+_BUILD_DIR = os.path.join(_REPO, "native", "build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libparsec_core.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_error: Optional[str] = None
+
+_SOURCES = ["zone.cpp", "graph.cpp"]
+
+
+def _newest_mtime(paths: Sequence[str]) -> float:
+    return max(os.path.getmtime(p) for p in paths)
+
+
+def _build() -> Optional[str]:
+    """Compile the shared library if missing/stale; returns its path or
+    None (recording the failure for diagnostics)."""
+    global _build_error
+    srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+    if not all(os.path.exists(s) for s in srcs):
+        _build_error = f"sources missing under {_SRC_DIR}"
+        return None
+    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= _newest_mtime(srcs):
+        return _LIB_PATH
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O2", "-g", "-std=c++17", "-fPIC", "-shared", "-pthread",
+           "-o", _LIB_PATH + ".tmp", *srcs]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        _build_error = f"g++ invocation failed: {e}"
+        return None
+    if proc.returncode != 0:
+        _build_error = f"g++ failed:\n{proc.stderr[-2000:]}"
+        return None
+    os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
+    return _LIB_PATH
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = _build()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+        # zone allocator
+        lib.pz_zone_new.restype = ctypes.c_void_p
+        lib.pz_zone_new.argtypes = [ctypes.c_size_t]
+        lib.pz_zone_destroy.argtypes = [ctypes.c_void_p]
+        lib.pz_zone_alloc.restype = ctypes.c_int64
+        lib.pz_zone_alloc.argtypes = [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t]
+        lib.pz_zone_release.restype = ctypes.c_int
+        lib.pz_zone_release.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pz_zone_used.restype = ctypes.c_size_t
+        lib.pz_zone_used.argtypes = [ctypes.c_void_p]
+        lib.pz_zone_capacity.restype = ctypes.c_size_t
+        lib.pz_zone_capacity.argtypes = [ctypes.c_void_p]
+        lib.pz_zone_largest_free.restype = ctypes.c_int64
+        lib.pz_zone_largest_free.argtypes = [ctypes.c_void_p]
+        lib.pz_zone_num_live.restype = ctypes.c_int64
+        lib.pz_zone_num_live.argtypes = [ctypes.c_void_p]
+        # graph engine
+        lib.pz_graph_new.restype = ctypes.c_void_p
+        lib.pz_graph_destroy.argtypes = [ctypes.c_void_p]
+        lib.pz_graph_add_task.restype = ctypes.c_int64
+        lib.pz_graph_add_task.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_int64]
+        lib.pz_graph_add_dep.restype = ctypes.c_int
+        lib.pz_graph_add_dep.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+        lib.pz_graph_task_commit.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pz_graph_seal.argtypes = [ctypes.c_void_p]
+        lib.pz_graph_run.restype = ctypes.c_int64
+        lib.pz_graph_run.argtypes = [ctypes.c_void_p, BODY_FN, ctypes.c_void_p,
+                                     ctypes.c_int32]
+        lib.pz_graph_executed.restype = ctypes.c_int64
+        lib.pz_graph_executed.argtypes = [ctypes.c_void_p]
+        lib.pz_graph_order.restype = ctypes.c_int64
+        lib.pz_graph_order.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+        _lib = lib
+        return lib
+
+
+BODY_FN = ctypes.CFUNCTYPE(None, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p)
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+class ZoneAllocator:
+    """Offset allocator over a byte budget (native first-fit + coalesce)."""
+
+    def __init__(self, capacity: int):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native core unavailable: {_build_error}")
+        self._lib = lib
+        self._z = lib.pz_zone_new(capacity)
+        if not self._z:
+            raise MemoryError("zone allocation failed")
+
+    def alloc(self, nbytes: int, align: int = 256) -> Optional[int]:
+        """Returns a byte offset, or None when fragmented/full."""
+        off = self._lib.pz_zone_alloc(self._z, nbytes, align)
+        return None if off < 0 else off
+
+    def release(self, offset: int) -> None:
+        if self._lib.pz_zone_release(self._z, offset) != 0:
+            raise ValueError(f"unknown offset {offset}")
+
+    @property
+    def used(self) -> int:
+        return self._lib.pz_zone_used(self._z)
+
+    @property
+    def capacity(self) -> int:
+        return self._lib.pz_zone_capacity(self._z)
+
+    @property
+    def largest_free(self) -> int:
+        return self._lib.pz_zone_largest_free(self._z)
+
+    @property
+    def num_live(self) -> int:
+        return self._lib.pz_zone_num_live(self._z)
+
+    def close(self) -> None:
+        if getattr(self, "_z", None):
+            self._lib.pz_zone_destroy(self._z)
+            self._z = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeGraph:
+    """Dataflow graph executed (or ordered) by the native engine.
+
+    Two usage modes:
+      * build-then-``order()`` — linearise a static DAG for whole-graph
+        XLA lowering (no commit/seal needed);
+      * ``add_task``/``add_dep``/``commit`` + ``seal`` + ``run(body)`` —
+        execute with native worker threads; ``body(task_id, user_tag)``
+        is a Python callable entered through a ctypes trampoline.
+    """
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native core unavailable: {_build_error}")
+        self._lib = lib
+        self._g = lib.pz_graph_new()
+        self._n = 0
+        self._keepalive: List = []
+
+    def add_task(self, priority: int = 0, user_tag: int = 0) -> int:
+        self._n += 1
+        return self._lib.pz_graph_add_task(self._g, priority, user_tag)
+
+    def add_dep(self, pred: int, succ: int) -> bool:
+        """True if the edge was recorded, False if pred already ran."""
+        rc = self._lib.pz_graph_add_dep(self._g, pred, succ)
+        if rc < 0:
+            raise ValueError(f"bad task id in edge {pred}->{succ}")
+        return rc == 1
+
+    def commit(self, task_id: int) -> None:
+        self._lib.pz_graph_task_commit(self._g, task_id)
+
+    def seal(self) -> None:
+        self._lib.pz_graph_seal(self._g)
+
+    def run(self, body: Callable[[int, int], None], nthreads: int = 2) -> int:
+        """Execute until quiescence; returns executed count. Exceptions
+        in ``body`` are captured and re-raised after the run drains."""
+        errors: List[BaseException] = []
+
+        @BODY_FN
+        def trampoline(task_id, user_tag, _ctx):
+            try:
+                body(task_id, user_tag)
+            except BaseException as e:  # noqa: BLE001 - relayed to caller
+                errors.append(e)
+
+        self._keepalive.append(trampoline)
+        n = self._lib.pz_graph_run(self._g, trampoline, None, nthreads)
+        if errors:
+            raise errors[0]
+        if n < 0:
+            raise RuntimeError("graph did not quiesce (cycle or uncommitted task)")
+        return n
+
+    def order(self) -> List[int]:
+        """Priority-greedy topological order of a build-mode graph."""
+        buf = (ctypes.c_int64 * max(self._n, 1))()
+        n = self._lib.pz_graph_order(self._g, buf, self._n)
+        if n < 0:
+            raise RuntimeError("cycle detected (or graph already executed)")
+        return list(buf[:n])
+
+    @property
+    def executed(self) -> int:
+        return self._lib.pz_graph_executed(self._g)
+
+    def close(self) -> None:
+        if getattr(self, "_g", None):
+            self._lib.pz_graph_destroy(self._g)
+            self._g = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,237 @@
+"""Native C++ core: zone allocator + dataflow graph engine (the runtime's
+native hot-path layer; reference roles: zone_malloc.c, scheduling.c)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu import native
+
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native core unavailable: {native.build_error()}")
+
+
+# -- zone allocator ---------------------------------------------------------
+
+def test_zone_alloc_release_coalesce():
+    z = native.ZoneAllocator(1 << 20)
+    a = z.alloc(1000)
+    b = z.alloc(2000)
+    c = z.alloc(4000)
+    assert {a, b, c} and len({a, b, c}) == 3
+    assert z.used == 1000 + 2000 + 4000
+    # free the middle, then neighbours: everything must coalesce back
+    z.release(b)
+    z.release(a)
+    z.release(c)
+    assert z.used == 0
+    assert z.largest_free == z.capacity
+    z.close()
+
+
+def test_zone_alignment_and_exhaustion():
+    z = native.ZoneAllocator(4096)
+    off = z.alloc(100, align=256)
+    assert off % 256 == 0
+    assert z.alloc(1 << 30) is None  # larger than capacity
+    # fill completely
+    got = []
+    while True:
+        o = z.alloc(512, align=1)
+        if o is None:
+            break
+        got.append(o)
+    assert z.alloc(512, align=1) is None
+    for o in got:
+        z.release(o)
+    assert z.used - 100 <= z.used  # the aligned first block still live
+    z.close()
+
+
+def test_zone_unknown_offset_rejected():
+    z = native.ZoneAllocator(1024)
+    with pytest.raises(ValueError):
+        z.release(12345)
+    z.close()
+
+
+def test_zone_threaded_stress():
+    z = native.ZoneAllocator(1 << 22)
+    errs = []
+
+    def churn(seed):
+        rng = np.random.default_rng(seed)
+        mine = []
+        try:
+            for _ in range(500):
+                if mine and rng.random() < 0.45:
+                    z.release(mine.pop(rng.integers(len(mine))))
+                else:
+                    o = z.alloc(int(rng.integers(64, 4096)))
+                    if o is not None:
+                        mine.append(o)
+            for o in mine:
+                z.release(o)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=churn, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+    assert z.used == 0
+    z.close()
+
+
+# -- graph engine -----------------------------------------------------------
+
+def test_graph_chain_order_and_run():
+    g = native.NativeGraph()
+    ids = [g.add_task(user_tag=i) for i in range(10)]
+    for a, b in zip(ids, ids[1:]):
+        g.add_dep(a, b)
+    assert g.order() == ids  # chain has a unique order
+
+    ran = []
+    for t in ids:
+        g.commit(t)
+    g.seal()
+    n = g.run(lambda tid, tag: ran.append(tag), nthreads=2)
+    assert n == 10
+    assert ran == list(range(10))
+    g.close()
+
+
+def test_graph_priority_order():
+    """Independent tasks come out highest-priority-first."""
+    g = native.NativeGraph()
+    ids = [g.add_task(priority=p) for p in (1, 9, 5, 7, 3)]
+    order = g.order()
+    prios = [(1, 9, 5, 7, 3)[i] for i in order]
+    assert prios == sorted(prios, reverse=True)
+    g.close()
+
+
+def test_graph_diamond_respects_deps():
+    g = native.NativeGraph()
+    a, b, c, d = (g.add_task(user_tag=t) for t in range(4))
+    g.add_dep(a, b)
+    g.add_dep(a, c)
+    g.add_dep(b, d)
+    g.add_dep(c, d)
+    seen = []
+    lock = threading.Lock()
+    for t in (a, b, c, d):
+        g.commit(t)
+    g.seal()
+    g.run(lambda tid, tag: (lock.acquire(), seen.append(tag), lock.release()),
+          nthreads=3)
+    assert seen[0] == 0 and seen[-1] == 3 and set(seen) == {0, 1, 2, 3}
+    g.close()
+
+
+def test_graph_cycle_detected():
+    g = native.NativeGraph()
+    a = g.add_task()
+    b = g.add_task()
+    g.add_dep(a, b)
+    g.add_dep(b, a)
+    with pytest.raises(RuntimeError):
+        g.order()
+    g.close()
+
+
+def test_graph_streaming_insertion():
+    """DTD shape: a running body inserts more tasks."""
+    g = native.NativeGraph()
+    ran = []
+    lock = threading.Lock()
+
+    def body(tid, tag):
+        with lock:
+            ran.append(tag)
+        if tag < 5:  # each task spawns the next (task-inserting-task)
+            nxt = g.add_task(user_tag=tag + 1)
+            g.add_dep(tid, nxt)  # returns False (tid still running? no: running != done)
+            g.commit(nxt)
+        if tag == 5:
+            g.seal()
+
+    first = g.add_task(user_tag=0)
+    g.commit(first)
+    n = g.run(body, nthreads=2)
+    assert n == 6
+    assert ran == [0, 1, 2, 3, 4, 5]
+    g.close()
+
+
+def test_graph_body_exception_propagates():
+    g = native.NativeGraph()
+    t = g.add_task()
+    g.commit(t)
+    g.seal()
+    with pytest.raises(ZeroDivisionError):
+        g.run(lambda tid, tag: 1 / 0, nthreads=1)
+    g.close()
+
+
+def test_graph_edge_to_done_pred_reports_satisfied():
+    g = native.NativeGraph()
+    a = g.add_task()
+    g.commit(a)
+    done = threading.Event()
+    b_holder = []
+
+    def body(tid, tag):
+        pass
+
+    # run a first, then add b depending on a: add_dep must report False
+    t = threading.Thread(target=lambda: g.run(body, nthreads=1))
+    b = g.add_task()
+    t_start = t.start()
+    import time
+    time.sleep(0.2)  # a executes
+    assert g.add_dep(a, b) is False
+    g.commit(b)
+    g.seal()
+    t.join(timeout=10)
+    assert g.executed == 2
+    g.close()
+
+
+def test_graph_large_order_fast():
+    """50k-task tiled-cholesky-shaped DAG orders quickly (native path)."""
+    import time
+
+    g = native.NativeGraph()
+    NT = 36  # ~ NT^3/6 + O(NT^2) tasks
+    ids = {}
+    for k in range(NT):
+        ids[("p", k)] = g.add_task(priority=3 * (NT - k))
+        for i in range(k + 1, NT):
+            ids[("t", k, i)] = g.add_task(priority=2 * (NT - k))
+        for i in range(k + 1, NT):
+            for j in range(k + 1, i + 1):
+                ids[("g", k, i, j)] = g.add_task(priority=NT - k)
+    for k in range(NT):
+        for i in range(k + 1, NT):
+            g.add_dep(ids[("p", k)], ids[("t", k, i)])
+            for j in range(k + 1, i + 1):
+                g.add_dep(ids[("t", k, i)], ids[("g", k, i, j)])
+                if j < i:
+                    g.add_dep(ids[("t", k, j)], ids[("g", k, i, j)])
+        if k + 1 < NT:
+            g.add_dep(ids[("g", k, k + 1, k + 1)], ids[("p", k + 1)])
+    t0 = time.perf_counter()
+    order = g.order()
+    dt = time.perf_counter() - t0
+    assert len(order) == len(ids)
+    pos = {t: i for i, t in enumerate(order)}
+    # spot-check dependency respect
+    assert pos[ids[("p", 0)]] < pos[ids[("t", 0, 1)]] < pos[ids[("g", 0, 1, 1)]]
+    assert dt < 2.0, f"native order too slow: {dt:.3f}s for {len(ids)} tasks"
+    g.close()
