@@ -238,16 +238,18 @@ int64_t pz_graph_order(void* gp, int64_t* out, int64_t cap) {
     std::vector<int32_t> miss(n);
     for (int64_t i = 0; i < n; ++i)
         miss[i] = g->tasks[i]->missing.load(std::memory_order_relaxed) - 1;
+    // ids negated: equal-priority tasks pop in insertion order, matching
+    // the Python heap's (−prio, seq) tie-break for deterministic lowering
     std::priority_queue<Ready> pq;
     for (int64_t i = 0; i < n; ++i)
-        if (miss[i] == 0) pq.push({g->tasks[i]->priority, i});
+        if (miss[i] == 0) pq.push({g->tasks[i]->priority, -i});
     int64_t written = 0;
     while (!pq.empty()) {
-        int64_t id = pq.top().second;
+        int64_t id = -pq.top().second;
         pq.pop();
         out[written++] = id;
         for (int64_t s : g->tasks[id]->succs)
-            if (--miss[s] == 0) pq.push({g->tasks[s]->priority, s});
+            if (--miss[s] == 0) pq.push({g->tasks[s]->priority, -s});
     }
     return written == n ? written : -1;
 }

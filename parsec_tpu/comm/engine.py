@@ -34,7 +34,8 @@ TAG_GET = 1             # payload pull request
 TAG_PUT = 2             # payload push / get answer
 TAG_TERMDET = 3         # termination-detection waves (fourcounter)
 TAG_CTL = 4             # generic control
-TAG_USER_BASE = 5
+TAG_DTD = 5             # DTD tile-version transfers (shadow-task protocol)
+TAG_USER_BASE = 6
 MAX_AM_TAGS = 12
 
 
@@ -72,7 +73,11 @@ class CommEngine(Component):
         raise NotImplementedError
 
     # -- one-sided ------------------------------------------------------
-    def mem_register(self, handle: Any, buffer: Any) -> None:
+    def mem_register(self, handle: Any, buffer: Any, once: bool = False) -> None:
+        """Expose ``buffer`` for one-sided GETs under ``handle``. With
+        ``once`` the registration is consumed by the first GET served —
+        used for single-consumer transfers (e.g. DTD tile versions) so
+        epoch-keyed handles don't pin buffers forever."""
         raise NotImplementedError
 
     def mem_unregister(self, handle: Any) -> None:

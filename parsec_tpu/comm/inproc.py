@@ -45,6 +45,7 @@ class InprocFabric:
         self.nranks = nranks
         self.inboxes: List["queue.SimpleQueue"] = [queue.SimpleQueue() for _ in range(nranks)]
         self.mem: Dict[Any, Any] = {}
+        self.mem_once: set = set()
         self.mem_lock = threading.Lock()
         self._barrier = threading.Barrier(nranks)
         self.engines: List[Optional["InprocComm"]] = [None] * nranks
@@ -87,13 +88,16 @@ class InprocComm(CommEngine):
             peer.context._notify_work()
 
     # -- one-sided ------------------------------------------------------
-    def mem_register(self, handle: Any, buffer: Any) -> None:
+    def mem_register(self, handle: Any, buffer: Any, once: bool = False) -> None:
         with self.fabric.mem_lock:
             self.fabric.mem[(self.rank, handle)] = buffer
+            if once:
+                self.fabric.mem_once.add((self.rank, handle))
 
     def mem_unregister(self, handle: Any) -> None:
         with self.fabric.mem_lock:
             self.fabric.mem.pop((self.rank, handle), None)
+            self.fabric.mem_once.discard((self.rank, handle))
 
     def get(self, src_rank: int, handle: Any, on_done) -> None:
         """Emulated one-sided pull (the reference emulates put/get with AM
@@ -101,6 +105,9 @@ class InprocComm(CommEngine):
         memory)."""
         with self.fabric.mem_lock:
             buf = self.fabric.mem.get((src_rank, handle))
+            if (src_rank, handle) in self.fabric.mem_once:
+                self.fabric.mem.pop((src_rank, handle), None)
+                self.fabric.mem_once.discard((src_rank, handle))
         if buf is None:
             raise KeyError(f"no registered memory {handle!r} at rank {src_rank}")
         self.stats["get_bytes"] += _payload_bytes(buf)

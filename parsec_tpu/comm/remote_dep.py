@@ -26,7 +26,7 @@ import numpy as np
 
 from ..utils import debug, mca_param
 from ..data.data import data_create
-from .engine import CommEngine, TAG_ACTIVATE
+from .engine import CommEngine, TAG_ACTIVATE, TAG_DTD
 
 
 class RemoteDepManager:
@@ -38,6 +38,7 @@ class RemoteDepManager:
         self._taskpools: Dict[str, Any] = {}
         #: parked activations for unknown taskpools (reference noobj fifo)
         self._noobj: Dict[str, List[Tuple[int, dict]]] = collections.defaultdict(list)
+        self._noobj_dtd: Dict[str, List[Tuple[int, dict]]] = collections.defaultdict(list)
         self._lock = threading.Lock()
         self.short_limit = mca_param.register(
             "runtime", "comm_short_limit", 1 << 16,
@@ -45,6 +46,7 @@ class RemoteDepManager:
         self.stats = collections.Counter()
         # register LAST: backends with a live comm thread may replay parked
         # activations synchronously from inside register_am
+        ce.register_am(TAG_DTD, self._on_dtd)
         ce.register_am(TAG_ACTIVATE, self._on_activate)
 
     # -- taskpool registry ----------------------------------------------
@@ -52,8 +54,11 @@ class RemoteDepManager:
         with self._lock:
             self._taskpools[tp.name] = tp
             parked = self._noobj.pop(tp.name, [])
+            parked_dtd = self._noobj_dtd.pop(tp.name, [])
         for src, msg in parked:
             self._deliver(tp, src, msg)
+        for src, msg in parked_dtd:
+            self._deliver_dtd(tp, src, msg)
 
     def taskpool_done(self, tp) -> None:
         with self._lock:
@@ -132,3 +137,45 @@ class RemoteDepManager:
             succ_class=msg["succ_class"],
             succ_locs=tuple(msg["succ_locs"]),
         )
+
+    # -- DTD tile-version channel (shadow-task protocol) -----------------
+    def send_dtd(self, tp, wire_key, epoch: int, payload: np.ndarray, dst_rank: int) -> None:
+        """Ship one tile version to the rank that will consume it. Small
+        payloads inline; large ones advertise a one-sided GET handle (same
+        short-limit policy as PTG activations, remote_dep_mpi.c:1319)."""
+        msg = {"pool": tp.name, "tile": wire_key, "epoch": epoch}
+        if payload.nbytes <= self.short_limit:
+            msg["kind"] = "inline"
+            msg["data"] = payload
+            self.stats["dtd_inline_sent"] += 1
+        else:
+            handle = ("dtd", tp.name, wire_key, epoch, dst_rank)
+            # exactly one consumer pulls each (tile, epoch, dst) handle:
+            # consume-on-serve so epoch-keyed registrations don't pile up
+            self.ce.mem_register(handle, payload, once=True)
+            msg["kind"] = "get"
+            msg["handle"] = handle
+            self.stats["dtd_get_advertised"] += 1
+        self.stats["dtd_sent"] += 1
+        self.ce.send_am(TAG_DTD, dst_rank, msg)
+
+    def _on_dtd(self, src_rank: int, msg: dict) -> None:
+        tp = self._taskpools.get(msg["pool"])
+        if tp is None:
+            with self._lock:
+                tp = self._taskpools.get(msg["pool"])
+                if tp is None:
+                    self._noobj_dtd[msg["pool"]].append((src_rank, msg))
+                    self.stats["dtd_parked"] += 1
+                    return
+        self._deliver_dtd(tp, src_rank, msg)
+
+    def _deliver_dtd(self, tp, src_rank: int, msg: dict) -> None:
+        self.stats["dtd_recv"] += 1
+        key = tuple(msg["tile"]) if isinstance(msg["tile"], list) else msg["tile"]
+        if msg["kind"] == "get":
+            self.ce.get(
+                src_rank, msg["handle"],
+                lambda buf: tp.dtd_incoming(key, msg["epoch"], buf))
+        else:
+            tp.dtd_incoming(key, msg["epoch"], msg["data"])

@@ -98,6 +98,7 @@ class TCPComm(CommEngine):
         self._am_lock = threading.Lock()
         self._unclaimed: Dict[int, List[Tuple[int, Any]]] = collections.defaultdict(list)
         self._mem: Dict[Any, Any] = {}
+        self._mem_once: set = set()
         self._mem_lock = threading.Lock()
         self._pending_gets: Dict[int, Callable[[Any], None]] = {}
         self._get_seq = 0
@@ -211,18 +212,29 @@ class TCPComm(CommEngine):
             pass
 
     # -- one-sided (AM-handshake emulation) ------------------------------
-    def mem_register(self, handle: Any, buffer: Any) -> None:
+    def mem_register(self, handle: Any, buffer: Any, once: bool = False) -> None:
         with self._mem_lock:
             self._mem[handle] = buffer
+            if once:
+                self._mem_once.add(handle)
 
     def mem_unregister(self, handle: Any) -> None:
         with self._mem_lock:
             self._mem.pop(handle, None)
+            self._mem_once.discard(handle)
+
+    def _mem_take(self, handle: Any, default=None):
+        """Read a registered buffer; consume the registration if once."""
+        with self._mem_lock:
+            buf = self._mem.get(handle, default)
+            if handle in self._mem_once:
+                self._mem.pop(handle, None)
+                self._mem_once.discard(handle)
+        return buf
 
     def get(self, src_rank: int, handle: Any, on_done) -> None:
         if src_rank == self.rank:
-            with self._mem_lock:
-                buf = self._mem.get(handle)
+            buf = self._mem_take(handle)
             if buf is None:
                 raise KeyError(f"no registered memory {handle!r} locally")
             on_done(buf)
@@ -234,8 +246,7 @@ class TCPComm(CommEngine):
         self.send_am(TAG_GET_REQ, src_rank, {"req": req, "handle": handle})
 
     def _on_get_req(self, src: int, msg: dict) -> None:
-        with self._mem_lock:
-            buf = self._mem.get(msg["handle"], _MISSING)
+        buf = self._mem_take(msg["handle"], _MISSING)
         if buf is _MISSING:
             debug.error("rank %d: GET for unknown handle %r", self.rank, msg["handle"])
             self.send_am(TAG_GET_ANS, src,

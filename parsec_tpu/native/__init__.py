@@ -54,8 +54,11 @@ def _build() -> Optional[str]:
     if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= _newest_mtime(srcs):
         return _LIB_PATH
     os.makedirs(_BUILD_DIR, exist_ok=True)
+    # per-process temp: concurrent builds (multi-process TCP ranks on one
+    # host) must not interleave writes before the atomic publish
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
     cmd = ["g++", "-O2", "-g", "-std=c++17", "-fPIC", "-shared", "-pthread",
-           "-o", _LIB_PATH + ".tmp", *srcs]
+           "-o", tmp, *srcs]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
     except (OSError, subprocess.TimeoutExpired) as e:
@@ -64,7 +67,7 @@ def _build() -> Optional[str]:
     if proc.returncode != 0:
         _build_error = f"g++ failed:\n{proc.stderr[-2000:]}"
         return None
-    os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
+    os.replace(tmp, _LIB_PATH)
     return _LIB_PATH
 
 
