@@ -63,6 +63,20 @@ class RemoteDepManager:
     def taskpool_done(self, tp) -> None:
         with self._lock:
             self._taskpools.pop(tp.name, None)
+            self._noobj.pop(tp.name, None)
+            self._noobj_dtd.pop(tp.name, None)
+
+    def _lookup_or_park(self, src_rank: int, msg: dict, parked, stat: str):
+        """Resolve the target taskpool or park the message until it
+        registers (reference noobj fifo, remote_dep_mpi.c:102)."""
+        tp = self._taskpools.get(msg["pool"])
+        if tp is None:
+            with self._lock:
+                tp = self._taskpools.get(msg["pool"])
+                if tp is None:
+                    parked[msg["pool"]].append((src_rank, msg))
+                    self.stats[stat] += 1
+        return tp
 
     # -- producer side ---------------------------------------------------
     def send_activation(
@@ -103,15 +117,9 @@ class RemoteDepManager:
 
     # -- receiver side ---------------------------------------------------
     def _on_activate(self, src_rank: int, msg: dict) -> None:
-        tp = self._taskpools.get(msg["pool"])
-        if tp is None:
-            with self._lock:
-                tp = self._taskpools.get(msg["pool"])
-                if tp is None:
-                    self._noobj[msg["pool"]].append((src_rank, msg))
-                    self.stats["parked"] += 1
-                    return
-        self._deliver(tp, src_rank, msg)
+        tp = self._lookup_or_park(src_rank, msg, self._noobj, "parked")
+        if tp is not None:
+            self._deliver(tp, src_rank, msg)
 
     def _deliver(self, tp, src_rank: int, msg: dict) -> None:
         self.stats["activations_recv"] += 1
@@ -160,15 +168,9 @@ class RemoteDepManager:
         self.ce.send_am(TAG_DTD, dst_rank, msg)
 
     def _on_dtd(self, src_rank: int, msg: dict) -> None:
-        tp = self._taskpools.get(msg["pool"])
-        if tp is None:
-            with self._lock:
-                tp = self._taskpools.get(msg["pool"])
-                if tp is None:
-                    self._noobj_dtd[msg["pool"]].append((src_rank, msg))
-                    self.stats["dtd_parked"] += 1
-                    return
-        self._deliver_dtd(tp, src_rank, msg)
+        tp = self._lookup_or_park(src_rank, msg, self._noobj_dtd, "dtd_parked")
+        if tp is not None:
+            self._deliver_dtd(tp, src_rank, msg)
 
     def _deliver_dtd(self, tp, src_rank: int, msg: dict) -> None:
         self.stats["dtd_recv"] += 1

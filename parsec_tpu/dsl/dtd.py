@@ -328,13 +328,17 @@ class DTDTaskpool(Taskpool):
         *args: Any,
         priority: int = 0,
         name: Optional[str] = None,
-    ) -> Task:
+    ) -> Optional[Task]:
         """Reference ``parsec_dtd_insert_task`` (insert_function.h:281).
 
         ``args`` entries:
           * ``(Data, AccessMode)``        — tracked dataflow argument
           * ``((shape, dtype), SCRATCH)`` — per-task scratch buffer
           * ``(value, VALUE)`` or bare value — captured by value
+
+        Returns the inserted :class:`Task`, or ``None`` when the task's
+        affinity places it on another rank (shadow insertion — the
+        reference's remote tasks are likewise not handed back).
         """
         if not self._open:
             raise RuntimeError("taskpool closed for insertion")
@@ -741,6 +745,9 @@ class DTDTaskpool(Taskpool):
                 if self._inserted - self._retired <= self.threshold:
                     return
             if not self.context.help_execute_one():
+                # the backlog may be recv tasks blocked on remote arrivals:
+                # drain the comm engine or a full window deadlocks the rank
+                self.context._progress_comm()
                 with self._quiesce:
                     self._quiesce.wait(0.001)
 
