@@ -1,0 +1,37 @@
+"""DTD with arguments — VALUE capture and tracked INOUT tiles.
+
+Reference analog: ``examples/interfaces/dtd/dtd_example_hello_arg.c`` —
+tasks receive by-value arguments and tracked data tiles; the runtime
+infers the RAW chain on the tile from insertion order.
+"""
+
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), "..", ".."))  # run without install
+
+import numpy as np
+
+from parsec_tpu import Context
+from parsec_tpu.data import data_create
+from parsec_tpu.dsl.dtd import DTDTaskpool, INOUT, VALUE
+
+
+def main() -> None:
+    with Context(nb_cores=2) as ctx:
+        tile = data_create("acc", payload=np.zeros(1))
+        tp = DTDTaskpool(ctx, "hello_arg")
+
+        def add(acc, amount):          # tracked tile + value argument
+            acc += amount
+
+        for i in range(10):
+            tp.insert_task(add, (tile, INOUT), (float(i), VALUE))
+        assert tp.wait(timeout=10)
+        tp.close()
+
+        total = float(tile.newest_copy().payload[0])
+    assert total == sum(range(10)), total
+    print(f"dtd_hello_arg: 10 inserted tasks accumulated {total:.0f}")
+
+
+if __name__ == "__main__":
+    main()

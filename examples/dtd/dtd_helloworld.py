@@ -1,0 +1,28 @@
+"""DTD hello world — sequential-looking task insertion.
+
+Reference analog: ``examples/interfaces/dtd/dtd_example_hello_world.c``
+— create a DTD taskpool, insert one task with no tracked data, wait.
+Dependencies are inferred at insertion time; with none, the task is
+immediately ready.
+"""
+
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), "..", ".."))  # run without install
+
+from parsec_tpu import Context
+from parsec_tpu.dsl.dtd import DTDTaskpool
+
+
+def main() -> None:
+    said = []
+    with Context(nb_cores=2) as ctx:
+        tp = DTDTaskpool(ctx, "hello")
+        tp.insert_task(lambda: said.append("Hello world from a DTD task"))
+        assert tp.wait(timeout=10)
+        tp.close()              # end of insertion: pool can terminate
+    assert said, "task did not run"
+    print("dtd_helloworld:", said[0])
+
+
+if __name__ == "__main__":
+    main()

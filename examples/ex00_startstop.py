@@ -1,0 +1,29 @@
+"""Ex00 — start/stop the runtime.
+
+Reference analog: ``examples/Ex00_StartStop.c`` — ``parsec_init`` /
+``parsec_fini`` with a worker-thread count. Here the :class:`Context`
+spawns the worker execution streams, installs the scheduler component,
+and attaches the device roster; ``fini`` quiesces and joins everything.
+"""
+
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), ".."))  # run without install
+
+from parsec_tpu import Context
+
+
+def main() -> None:
+    # nb_cores plays the role of the reference's `parsec_init(cores, ...)`
+    ctx = Context(nb_cores=2)
+    assert ctx.nb_workers == 2
+    assert ctx.wait(timeout=5)  # nothing enqueued: immediate quiescence
+    ctx.fini()
+
+    # contexts are also context managers (init/fini pairing enforced)
+    with Context(nb_cores=1) as ctx2:
+        assert ctx2.wait(timeout=5)
+    print("ex00: context started and stopped cleanly")
+
+
+if __name__ == "__main__":
+    main()

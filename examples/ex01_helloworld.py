@@ -1,0 +1,40 @@
+"""Ex01 — hello world: one PTG task class, one task.
+
+Reference analog: ``examples/Ex01_HelloWorld.jdf`` — a task class with a
+single-point execution space ``k = 0 .. 0``, placed by affinity onto a
+data collection. A task class always carries (1) an execution space,
+(2) a placement/affinity, (3) at least one flow; a pure side-effect task
+uses a CTL-style empty flow set, exactly like the reference's
+``HelloWorld(k)`` with no real data.
+"""
+
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), ".."))  # run without install
+
+import numpy as np
+
+from parsec_tpu import Context
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl.ptg import PTG
+
+
+def main() -> None:
+    said = []
+    dc = LocalCollection("world", shape=(1,), init=lambda k: np.zeros(1))
+
+    ptg = PTG("hello")
+    hello = ptg.task_class("hello", k="0 .. 0")  # one-point space
+    hello.affinity("world(k)")                   # owner-computes placement
+    hello.body(cpu=lambda k: said.append(f"Hello world (k={k})"))
+
+    with Context(nb_cores=2) as ctx:
+        tp = ptg.taskpool(world=dc)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=10)
+
+    assert said == ["Hello world (k=0)"], said
+    print("ex01:", said[0])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Ex02 — a chain of sequentially dependent tasks.
+
+Reference analog: ``examples/Ex02_Chain.jdf`` — tasks ``Task(k)`` for
+``k = 0 .. NB-1`` where each task depends on its predecessor through a
+control flow: no data moves, only ordering. Output dep guards
+(``(k < NB-1) ?``) cut the chain at the last task.
+"""
+
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), ".."))  # run without install
+
+import numpy as np
+
+from parsec_tpu import Context
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl.ptg import PTG
+
+NB = 12
+
+
+def main() -> None:
+    log = []
+    dc = LocalCollection("T", shape=(NB,), init=lambda k: np.zeros(1))
+
+    ptg = PTG("chain")
+    step = ptg.task_class("step", k="0 .. NB-1")
+    step.affinity("T(k)")
+    # pure-control chain: <- from predecessor, -> to successor, guarded
+    step.ctl("c",
+             "<- (k > 0) ? c step(k-1)",
+             "-> (k < NB-1) ? c step(k+1)")
+    step.body(cpu=lambda k: log.append(k))
+
+    with Context(nb_cores=4) as ctx:
+        tp = ptg.taskpool(NB=NB, T=dc)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=15)
+
+    # despite 4 workers, control deps force strict sequential order
+    assert log == list(range(NB)), log
+    print(f"ex02: {NB} chained tasks ran in order on 4 workers")
+
+
+if __name__ == "__main__":
+    main()

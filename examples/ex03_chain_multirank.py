@@ -1,0 +1,84 @@
+"""Ex03 — the chain distributed across ranks over the comm engine.
+
+Reference analog: ``examples/Ex03_ChainMPI.jdf`` — same chain as Ex02,
+but the data collection round-robins tiles over ranks, so every link of
+the chain crosses the wire: task completion on rank r activates the
+successor on rank r+1 through the remote-dep protocol (activation
+message + payload transfer), exactly the reference's
+``parsec_remote_dep_activate`` path (SURVEY §3.4).
+
+Here the "ranks" are full runtime contexts talking through the
+in-process fabric (the reference's analog is mpiexec-on-one-node); the
+same code runs over real sockets via ``parsec_tpu.comm.tcp``.
+"""
+
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), ".."))  # run without install
+
+import threading
+
+import numpy as np
+
+from parsec_tpu import Context
+from parsec_tpu.comm import InprocFabric
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl.ptg import PTG, INOUT
+
+NRANKS, N = 4, 12
+
+
+def main() -> None:
+    fabric = InprocFabric(NRANKS)
+    ces = fabric.endpoints()
+    ctxs = [Context(nb_cores=2, rank=r, nranks=NRANKS, comm=ces[r])
+            for r in range(NRANKS)]
+    ran = {r: [] for r in range(NRANKS)}
+    oks = [False] * NRANKS
+    errors = []
+
+    def rank_main(rank: int) -> None:
+        dc = LocalCollection("D", shape=(N,), nodes=NRANKS, myrank=rank,
+                             init=lambda k: np.zeros(2))
+        dc.rank_of = lambda *key: dc.data_key(*key) % NRANKS
+
+        ptg = PTG("chainmpi")
+        step = ptg.task_class("step", k="0 .. N-1")
+        step.affinity("D(k)")  # task k runs on rank k % NRANKS
+        step.flow("X", INOUT,
+                  "<- (k == 0) ? D(0) : X step(k-1)",
+                  "-> (k < N-1) ? X step(k+1) : D(k)")
+
+        def body(X, k):
+            ran[rank].append(k)
+            X += 1.0
+
+        step.body(cpu=body)
+        tp = ptg.taskpool(N=N, D=dc)
+        ctxs[rank].add_taskpool(tp)
+        oks[rank] = tp.wait(timeout=60)
+
+    def guarded(rank: int) -> None:
+        try:
+            rank_main(rank)
+        except Exception as e:  # surface per-rank failures after join
+            errors.append((rank, e))
+
+    threads = [threading.Thread(target=guarded, args=(r,)) for r in range(NRANKS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for c in ctxs:
+        c.fini()
+
+    if errors:
+        raise errors[0][1]
+    assert all(oks), oks
+    for r in range(NRANKS):
+        assert ran[r] == list(range(r, N, NRANKS)), ran
+    print(f"ex03: chain of {N} hopped across {NRANKS} ranks "
+          f"({N - 1} remote activations)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Ex05 — broadcast: one producer, many consumers via an output range.
+
+Reference analog: ``examples/Ex05_Broadcast.jdf`` — a root task emits
+its flow to ``Task(0 .. NB-1)`` in one output dependency; the runtime
+expands the range into a multicast (and, multi-rank, routes it down a
+broadcast topology — star/chain/binomial, SURVEY §2.4). Consumers each
+get the same payload version.
+"""
+
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), ".."))  # run without install
+
+import threading
+
+import numpy as np
+
+from parsec_tpu import Context
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl.ptg import PTG, IN, INOUT
+
+NB = 16
+
+
+def main() -> None:
+    got = []
+    lock = threading.Lock()
+    dc = LocalCollection("D", shape=(1,), init=lambda k: np.full(4, 2.0))
+
+    ptg = PTG("broadcast")
+    root = ptg.task_class("root")
+    root.affinity("D(0)")
+    root.flow("A", INOUT, "<- D(0)", "-> A leaf(0 .. NB-1)")  # range = bcast
+    root.body(cpu=lambda A: A.__imul__(21.0))  # 2 * 21 = 42
+
+    leaf = ptg.task_class("leaf", k="0 .. NB-1")
+    leaf.affinity("D(0)")
+    leaf.flow("A", IN, "<- A root()")
+
+    def leaf_body(A, k):
+        with lock:
+            got.append((k, float(A[0])))
+
+    leaf.body(cpu=leaf_body)
+
+    with Context(nb_cores=4) as ctx:
+        tp = ptg.taskpool(NB=NB, D=dc)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=15)
+
+    assert sorted(k for k, _ in got) == list(range(NB))
+    assert all(v == 42.0 for _, v in got), got
+    print(f"ex05: root broadcast one tile to {NB} consumers")
+
+
+if __name__ == "__main__":
+    main()

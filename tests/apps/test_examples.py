@@ -1,0 +1,34 @@
+"""Smoke-run the tutorial examples (reference examples/Ex00..Ex07 +
+dtd examples are built and run by CI; here each example is executed
+in-process and must self-check)."""
+
+import os
+import runpy
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "..", "examples")
+
+ALL = [
+    "ex00_startstop.py",
+    "ex01_helloworld.py",
+    "ex02_chain.py",
+    "ex03_chain_multirank.py",
+    "ex04_chaindata.py",
+    "ex05_broadcast.py",
+    "ex06_raw.py",
+    "ex07_raw_ctl.py",
+    "ex08_tpu_graph.py",
+    os.path.join("dtd", "dtd_helloworld.py"),
+    os.path.join("dtd", "dtd_hello_arg.py"),
+    os.path.join("dtd", "dtd_untied.py"),
+]
+
+
+@pytest.mark.parametrize("script", ALL, ids=[os.path.basename(s) for s in ALL])
+def test_example_runs(script, capsys):
+    path = os.path.abspath(os.path.join(EXAMPLES, script))
+    runpy.run_path(path, run_name="__main__")
+    out = capsys.readouterr().out
+    assert ":" in out  # every example prints a self-check summary line
