@@ -20,7 +20,7 @@ NB = 12
 
 def main() -> None:
     log = []
-    dc = LocalCollection("T", shape=(NB,), init=lambda k: np.zeros(1))
+    dc = LocalCollection("T", shape=(1,), init=lambda k: np.zeros(1))
 
     ptg = PTG("chain")
     step = ptg.task_class("step", k="0 .. NB-1")

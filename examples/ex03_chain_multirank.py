@@ -37,7 +37,7 @@ def main() -> None:
     errors = []
 
     def rank_main(rank: int) -> None:
-        dc = LocalCollection("D", shape=(N,), nodes=NRANKS, myrank=rank,
+        dc = LocalCollection("D", shape=(2,), nodes=NRANKS, myrank=rank,
                              init=lambda k: np.zeros(2))
         dc.rank_of = lambda *key: dc.data_key(*key) % NRANKS
 

@@ -21,7 +21,7 @@ NB = 10
 
 
 def main() -> None:
-    dc = LocalCollection("D", shape=(1,), init=lambda k: np.zeros(4))
+    dc = LocalCollection("D", shape=(4,), init=lambda k: np.zeros(4))
 
     ptg = PTG("chaindata")
     step = ptg.task_class("step", k="0 .. NB-1")

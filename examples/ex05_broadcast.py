@@ -24,7 +24,7 @@ NB = 16
 def main() -> None:
     got = []
     lock = threading.Lock()
-    dc = LocalCollection("D", shape=(1,), init=lambda k: np.full(4, 2.0))
+    dc = LocalCollection("D", shape=(4,), init=lambda k: np.full(4, 2.0))
 
     ptg = PTG("broadcast")
     root = ptg.task_class("root")

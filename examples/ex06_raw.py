@@ -27,7 +27,7 @@ BCAST_VAL, UPDATED_VAL = 10.0, 1000.0
 def main() -> None:
     seen = []
     lock = threading.Lock()
-    dc = LocalCollection("D", shape=(1,), init=lambda k: np.full(2, 1.0))
+    dc = LocalCollection("D", shape=(2,), init=lambda k: np.full(2, 1.0))
 
     ptg = PTG("raw")
     bcast = ptg.task_class("bcast")
@@ -43,7 +43,8 @@ def main() -> None:
     update = ptg.task_class("update")
     update.affinity("D(0)")
     update.flow("A", INOUT, "<- A bcast()", "-> D(0)")
-    update.body(cpu=lambda A: A.__iadd__(UPDATED_VAL - BCAST_VAL), priority=100)
+    update.priority("100")  # runs early
+    update.body(cpu=lambda A: A.__iadd__(UPDATED_VAL - BCAST_VAL))
 
     recv = ptg.task_class("recv", k="0 .. NB-1")
     recv.affinity("D(0)")

@@ -25,7 +25,7 @@ NB = 8
 def main() -> None:
     order = []
     lock = threading.Lock()
-    dc = LocalCollection("D", shape=(1,), init=lambda k: np.full(2, 1.0))
+    dc = LocalCollection("D", shape=(2,), init=lambda k: np.full(2, 1.0))
 
     ptg = PTG("rawctl")
     bcast = ptg.task_class("bcast")
@@ -58,7 +58,8 @@ def main() -> None:
             order.append("update")
         A += 990.0
 
-    update.body(cpu=update_body, priority=100)  # high prio, still ordered
+    update.priority("100")  # high prio, still ordered by the CTL gather
+    update.body(cpu=update_body)
 
     with Context(nb_cores=4) as ctx:
         tp = ptg.taskpool(NB=NB, D=dc)

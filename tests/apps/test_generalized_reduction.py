@@ -1,0 +1,115 @@
+"""Generalized binary-tree reduction app (reference
+``tests/apps/generalized_reduction/BT_reduction.jdf``).
+
+Arbitrary N (not a power of two) decomposes into one perfect binary
+subtree per set bit of N; each subtree reduces independently, then a
+sequential "lineage" chain combines the subtree roots. Exercises:
+computed dependency expressions (bit arithmetic in dep guards), NEW
+tiles, disjoint-guard inputs, and fan-in trees through the PTG.
+"""
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl.ptg import PTG, IN, INOUT
+
+
+def bit_subtrees(N):
+    """[(offset, log2size)] per set bit of N, low bit first (reference
+    compute_offset/log_of_tree_size, BT_reduction.jdf:20-58)."""
+    out, off = [], 0
+    for b in range(N.bit_length()):
+        if N >> b & 1:
+            out.append((off, b))
+            off += 1 << b
+    return out
+
+
+def reduction_ptg() -> PTG:
+    """Build the BT-reduction PTG. Constants: N, T (=popcount), OFF(t),
+    LOGSZ(t) (1-indexed subtree helpers), collections TVAL (input tiles)
+    and RES (result tile 0)."""
+    ptg = PTG("bt_reduction")
+
+    red = ptg.task_class("red", t="1 .. T", l="1 .. LOGSZ(t)",
+                         i="0 .. 2**(LOGSZ(t)-l) - 1")
+    red.affinity("TVAL(OFF(t) + (2**l) * i)")
+    # left value arrives (and leaves) in A; right value in B
+    red.flow("A", INOUT,
+             "<- (l == 1) ? TVAL(OFF(t) + 2*i) : A red(t, l-1, 2*i)",
+             "-> (l < LOGSZ(t) and i % 2 == 0) ? A red(t, l+1, i//2)",
+             "-> (l < LOGSZ(t) and i % 2 == 1) ? B red(t, l+1, i//2)",
+             "-> (l == LOGSZ(t)) ? R lineage(t)")
+    red.flow("B", IN,
+             "<- (l == 1) ? TVAL(OFF(t) + 2*i + 1) : A red(t, l-1, 2*i+1)")
+    red.body(cpu=lambda A, B, **_: A.__iadd__(B))
+
+    lineage = ptg.task_class("lineage", t="1 .. T")
+    lineage.affinity("RES(0)")
+    lineage.flow("R", IN,
+                 "<- (LOGSZ(t) > 0) ? A red(t, LOGSZ(t), 0)",
+                 "<- (LOGSZ(t) == 0) ? TVAL(OFF(t))")
+    lineage.flow("S", INOUT,
+                 "<- (t == 1) ? NEW : S lineage(t-1)",
+                 "-> (t < T) ? S lineage(t+1)",
+                 "-> (t == T) ? RES(0)")
+    lineage.body(cpu=lambda S, R, **_: S.__iadd__(R))
+    return ptg
+
+
+@pytest.mark.parametrize("N", [1, 2, 3, 7, 12, 21])
+def test_bt_reduction_arbitrary_sizes(N):
+    """Sum of N tiles must equal numpy's, for power-of-two and ragged N."""
+    W = 4  # elements per tile
+    rng = np.random.default_rng(N)
+    vals = rng.integers(0, 100, size=(N, W)).astype(np.float64)
+
+    tv = LocalCollection("TVAL", shape=(W,), init=lambda k: vals[k].copy())
+    res = LocalCollection("RES", shape=(W,), init=lambda k: np.zeros(W))
+    subtrees = bit_subtrees(N)
+
+    with Context(nb_cores=4) as ctx:
+        tp = reduction_ptg().taskpool(
+            N=N, T=len(subtrees),
+            OFF=lambda t: subtrees[t - 1][0],
+            LOGSZ=lambda t: subtrees[t - 1][1],
+            TILE_SHAPE=(W,), TILE_DTYPE=np.float64,
+            TVAL=tv, RES=res)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=30)
+
+    np.testing.assert_allclose(res.data_of(0).newest_copy().payload,
+                               vals.sum(axis=0))
+
+
+def test_bt_reduction_task_count():
+    """N=21 (10101b): subtrees of 16+4+1 leaves -> 15+3+0 red tasks + 3
+    lineage tasks; the DAG executes exactly that many bodies."""
+    N, W = 21, 2
+    ran = []
+    tv = LocalCollection("TVAL", shape=(W,), init=lambda k: np.full(W, 1.0))
+    res = LocalCollection("RES", shape=(W,), init=lambda k: np.zeros(W))
+    subtrees = bit_subtrees(N)
+
+    ptg = reduction_ptg()
+    # wrap bodies to count executions
+    for cname in ("red", "lineage"):
+        pc = ptg.classes[cname]
+        orig = pc.bodies["cpu"]
+        pc.bodies["cpu"] = (lambda o, c: lambda *a, **kw: (ran.append(c), o(*a, **kw))[1])(orig, cname)
+
+    with Context(nb_cores=4) as ctx:
+        tp = ptg.taskpool(
+            N=N, T=len(subtrees),
+            OFF=lambda t: subtrees[t - 1][0],
+            LOGSZ=lambda t: subtrees[t - 1][1],
+            TILE_SHAPE=(W,), TILE_DTYPE=np.float64,
+            TVAL=tv, RES=res)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=30)
+
+    assert ran.count("red") == 15 + 3
+    assert ran.count("lineage") == 3
+    np.testing.assert_allclose(res.data_of(0).newest_copy().payload, float(N))

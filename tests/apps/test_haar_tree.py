@@ -1,0 +1,115 @@
+"""Haar-tree app: adaptive wavelet projection via dynamic task insertion
+(reference ``tests/apps/haar_tree/``: project.jdf / project_dyn.jdf +
+walk.jdf over a hash-keyed tree distribution ``tree_dist.c``).
+
+The tree is discovered at runtime: a task examining node (l, n) decides
+from the local detail coefficient whether to refine, and if so *inserts
+the child tasks itself* (task-inserting-task — the irregularity stress
+the reference uses haar_tree for). The tree lives in a hash-keyed
+collection whose keys are (level, index) pairs, like the reference's
+``tree_dist`` hash table of nodes. A second phase walks the finished
+tree and checks the projection reconstructs the function.
+"""
+
+import math
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl.dtd import DTDTaskpool, OUT
+
+LMIN, LMAX = 3, 10  # mandatory / maximum refinement depth
+
+
+def f(x: float) -> float:
+    """The projected function (smooth + a sharp feature, so refinement
+    depth varies across the domain — the adaptive case)."""
+    return math.sin(3.0 * x) + math.exp(-200.0 * (x - 0.35) ** 2)
+
+
+def avg(l: int, n: int) -> float:
+    """Average of f over the dyadic interval (l, n), 3-point estimate."""
+    a, b = n / (1 << l), (n + 1) / (1 << l)
+    return (f(a) + 2.0 * f((a + b) / 2) + f(b)) / 4.0
+
+
+def project(ctx, tree: LocalCollection, thresh: float) -> int:
+    """Build the adaptive Haar tree; returns the number of node tasks."""
+    tp = DTDTaskpool(ctx, "haar_project")
+    count = [0]
+    lock = threading.Lock()
+
+    def node_task(tile, l, n):
+        s = avg(l, n)
+        s0, s1 = avg(l + 1, 2 * n), avg(l + 1, 2 * n + 1)
+        d = (s0 - s1) / 2.0
+        tile[0], tile[1] = s, d
+        with lock:
+            count[0] += 1
+        if l < LMIN or (abs(d) > thresh and l < LMAX):
+            # dynamic discovery: this task inserts its children
+            insert(l + 1, 2 * n)
+            insert(l + 1, 2 * n + 1)
+
+    def insert(l, n):
+        tp.insert_task(node_task, (tree.data_of(l, n), OUT), l, n,
+                       name=f"node({l},{n})")
+
+    insert(0, 0)
+    assert tp.wait(timeout=60)
+    tp.close()
+    return count[0]
+
+
+def walk(tree: LocalCollection):
+    """Reference walk.jdf: visit every node; return (nodes, leaves,
+    integral estimate from leaf averages)."""
+    keys = set(tree.keys())
+    leaves, integral = [], 0.0
+    for (l, n) in keys:
+        if (l + 1, 2 * n) not in keys:  # leaf
+            leaves.append((l, n))
+            s = float(tree.data_of(l, n).newest_copy().payload[0])
+            integral += s / (1 << l)
+    return len(keys), leaves, integral
+
+
+@pytest.mark.parametrize("thresh", [1e-2, 1e-3])
+def test_haar_projection_adapts_and_reconstructs(thresh):
+    tree = LocalCollection("tree", shape=(2,), dtype=np.float64)
+    with Context(nb_cores=4) as ctx:
+        ntasks = project(ctx, tree, thresh)
+
+    nnodes, leaves, integral = walk(tree)
+    assert ntasks == nnodes  # one task per discovered node
+
+    # tree structure: children come in pairs (both or neither)
+    keys = set(tree.keys())
+    for (l, n) in keys:
+        assert ((l + 1, 2 * n) in keys) == ((l + 1, 2 * n + 1) in keys)
+
+    # leaves partition [0,1): their measures sum to 1
+    measure = sum(1.0 / (1 << l) for l, n in leaves)
+    assert abs(measure - 1.0) < 1e-12
+
+    # reconstruction: the leaf-average integral approximates ∫f
+    exact = sum(avg(14, n) / (1 << 14) for n in range(1 << 14))
+    assert abs(integral - exact) < 50 * thresh
+
+    # adaptivity: leaf depth must vary across the domain (a uniform grid
+    # would mean the detail criterion never pruned anything)
+    depths = {l for l, n in leaves}
+    assert len(depths) > 1 and max(depths) > LMIN, sorted(depths)
+
+
+def test_finer_threshold_refines_more():
+    trees = {}
+    for thresh in (1e-2, 1e-4):
+        tree = LocalCollection("tree", shape=(2,), dtype=np.float64)
+        with Context(nb_cores=4) as ctx:
+            project(ctx, tree, thresh)
+        trees[thresh] = len(tree.keys())
+    assert trees[1e-4] > trees[1e-2]
