@@ -26,7 +26,23 @@ import numpy as np
 
 from ..utils import debug, mca_param
 from ..data.data import data_create
+from ..profiling import pins
 from .engine import CommEngine, TAG_ACTIVATE, TAG_DTD
+
+
+def _key_words(key) -> int:
+    """32-bit word count of a DTD wire key (scalar or tuple)."""
+    return len(key) if isinstance(key, (tuple, list)) else 1
+
+
+def _wire_len(msg: dict) -> int:
+    """Logical activation-header length in bytes (reference
+    ``remote_dep_wire_activate_t``: taskpool_id, task_class_id, locals,
+    output_mask packed as 32-bit words). Deterministic so trace-based
+    regression tests can pin exact byte sums (tests/profiling/
+    check-comms.py analog); inline payload bytes are accounted by the
+    DATA_PLD event, not here."""
+    return 4 * (4 + len(msg["src_locals"]) + len(msg["succ_locs"]))
 
 
 class RemoteDepManager:
@@ -112,7 +128,14 @@ class RemoteDepManager:
             msg["kind"] = "get"
             msg["handle"] = handle
             self.stats["get_advertised"] += 1
+            if pins.active(pins.COMM_DATA_CTL):
+                pins.fire(pins.COMM_DATA_CTL, None,
+                          {"dst": dst_rank, "bytes": payload.nbytes})
         self.stats["activations_sent"] += 1
+        if pins.active(pins.COMM_ACTIVATE):
+            pins.fire(pins.COMM_ACTIVATE, None,
+                      {"dst": dst_rank, "bytes": _wire_len(msg),
+                       "class": src_class})
         self.ce.send_am(TAG_ACTIVATE, dst_rank, msg)
 
     # -- receiver side ---------------------------------------------------
@@ -137,6 +160,9 @@ class RemoteDepManager:
     def _complete_incoming(self, tp, msg: dict, buf: Optional[np.ndarray]) -> None:
         """Deposit arrived data and release the successor locally
         (reference remote_dep_release_incoming)."""
+        if buf is not None and pins.active(pins.COMM_DATA_PLD):
+            pins.fire(pins.COMM_DATA_PLD, None,
+                      {"bytes": buf.nbytes, "kind": msg["kind"]})
         tp.incoming_remote_release(
             src_class=msg["src_class"],
             src_locals=tuple(msg["src_locals"]),
@@ -164,7 +190,16 @@ class RemoteDepManager:
             msg["kind"] = "get"
             msg["handle"] = handle
             self.stats["dtd_get_advertised"] += 1
+            if pins.active(pins.COMM_DATA_CTL):
+                pins.fire(pins.COMM_DATA_CTL, None,
+                          {"dst": dst_rank, "bytes": payload.nbytes})
         self.stats["dtd_sent"] += 1
+        if pins.active(pins.COMM_ACTIVATE):
+            # DTD tile shipments are activations too (shadow-task wire):
+            # header = pool + tile key + epoch words
+            pins.fire(pins.COMM_ACTIVATE, None,
+                      {"dst": dst_rank, "bytes": 4 * (2 + _key_words(wire_key)),
+                       "class": "dtd"})
         self.ce.send_am(TAG_DTD, dst_rank, msg)
 
     def _on_dtd(self, src_rank: int, msg: dict) -> None:
@@ -175,9 +210,14 @@ class RemoteDepManager:
     def _deliver_dtd(self, tp, src_rank: int, msg: dict) -> None:
         self.stats["dtd_recv"] += 1
         key = tuple(msg["tile"]) if isinstance(msg["tile"], list) else msg["tile"]
+
+        def arrived(buf):
+            if pins.active(pins.COMM_DATA_PLD):
+                pins.fire(pins.COMM_DATA_PLD, None,
+                          {"bytes": buf.nbytes, "kind": msg["kind"]})
+            tp.dtd_incoming(key, msg["epoch"], buf)
+
         if msg["kind"] == "get":
-            self.ce.get(
-                src_rank, msg["handle"],
-                lambda buf: tp.dtd_incoming(key, msg["epoch"], buf))
+            self.ce.get(src_rank, msg["handle"], arrived)
         else:
-            tp.dtd_incoming(key, msg["epoch"], msg["data"])
+            arrived(msg["data"])

@@ -2,8 +2,9 @@
 live properties dictionary."""
 
 from . import pins
-from .trace import TaskProfiler, Trace
+from .trace import CommProfiler, TaskProfiler, Trace
 from .grapher import DotGrapher
 from . import dictionary
 
-__all__ = ["pins", "Trace", "TaskProfiler", "DotGrapher", "dictionary"]
+__all__ = ["pins", "Trace", "TaskProfiler", "CommProfiler", "DotGrapher",
+           "dictionary"]

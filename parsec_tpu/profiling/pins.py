@@ -28,6 +28,12 @@ COMPLETE_EXEC_BEGIN = "complete_exec_begin"
 COMPLETE_EXEC_END = "complete_exec_end"
 SCHEDULE_BEGIN = "schedule_begin"
 SCHEDULE_END = "schedule_end"
+# comm-thread sites (reference: the comm thread's own profiling stream
+# logging MPI_ACTIVATE / MPI_DATA_CTL / MPI_DATA_PLD events,
+# remote_dep_mpi.c:1198-1200)
+COMM_ACTIVATE = "comm_activate"
+COMM_DATA_CTL = "comm_data_ctl"
+COMM_DATA_PLD = "comm_data_pld"
 
 ALL_SITES = [v for k, v in list(globals().items()) if k.isupper() and isinstance(v, str)]
 
@@ -47,6 +53,12 @@ def unsubscribe(site: str, cb: Callable[..., None]) -> None:
     if lst and cb in lst:
         lst.remove(cb)
     _enabled = any(_subscribers.values())
+
+
+def active(site: str) -> bool:
+    """True when ``site`` has subscribers — lets hot paths skip building
+    event payloads entirely (reference PARSEC_PINS enable-mask gate)."""
+    return _enabled and bool(_subscribers.get(site))
 
 
 def fire(site: str, es: Any, payload: Any) -> None:

@@ -112,6 +112,12 @@ class Trace:
                     "begin_us": b["ts"], "end_us": e["ts"],
                     "dur_us": e["ts"] - b["ts"], **b.get("args", {}),
                 })
+            elif e["ph"] == "i":  # instants: zero-duration rows
+                rows.append({
+                    "name": e["name"], "pid": e["pid"], "tid": e["tid"],
+                    "begin_us": e["ts"], "end_us": e["ts"], "dur_us": 0.0,
+                    **e.get("args", {}),
+                })
         return pd.DataFrame(rows)
 
 
@@ -150,6 +156,40 @@ class TaskProfiler:
         b, e = mk("complete_exec", lambda task: {"task": repr(task)})
         self._sub(pins.COMPLETE_EXEC_BEGIN, b)
         self._sub(pins.COMPLETE_EXEC_END, e)
+        return self
+
+    def uninstall(self) -> None:
+        for site, cb in self._subs:
+            pins.unsubscribe(site, cb)
+        self._subs.clear()
+
+
+class CommProfiler:
+    """PINS module feeding comm-protocol events into a Trace (reference:
+    the comm thread's profiling stream logging MPI_ACTIVATE /
+    MPI_DATA_CTL / MPI_DATA_PLD, ``remote_dep_mpi.c:1198-1200``). Events
+    are instants carrying byte counts, so offline validators can pin
+    exact message/byte totals (``tests/profiling/check-comms.py``)."""
+
+    #: trace-event names, kept reference-compatible for the validators
+    ACTIVATE, DATA_CTL, DATA_PLD = "MPI_ACTIVATE", "MPI_DATA_CTL", "MPI_DATA_PLD"
+
+    def __init__(self, trace: Optional[Trace] = None):
+        self.trace = trace or Trace()
+        self._subs = []
+
+    def install(self) -> "CommProfiler":
+        t = self.trace
+        for name, site in ((self.ACTIVATE, pins.COMM_ACTIVATE),
+                           (self.DATA_CTL, pins.COMM_DATA_CTL),
+                           (self.DATA_PLD, pins.COMM_DATA_PLD)):
+            t.add_dictionary_keyword(name)
+
+            def cb(es, info, name=name):
+                t.instant(name, tid="comm", **(info or {}))
+
+            pins.subscribe(site, cb)
+            self._subs.append((site, cb))
         return self
 
     def uninstall(self) -> None:

@@ -1,0 +1,144 @@
+"""Trace-content comm regression — the reference's pandas validator
+``tests/profiling/check-comms.py:8-15`` pins exact MPI_ACTIVATE /
+MPI_DATA_CTL / MPI_DATA_PLD event counts and byte sums for a fixed
+bandwidth-app config. Same here: run the 2-rank bandwidth shape with the
+CommProfiler installed, convert the trace to pandas, assert exact
+counts/sums.
+"""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl.ptg import PTG, IN, INOUT
+from parsec_tpu.profiling import CommProfiler, Trace
+from parsec_tpu.utils import mca_param
+
+from tests.runtime.test_multirank import run_ranks
+
+
+def run_bandwidth(nflows: int, length_elems: int, short_limit: int):
+    """F independent src->sink transfers of L float64s across 2 ranks,
+    with CommProfiler tracing; returns the trace DataFrame."""
+    mca_param.set_param("runtime", "comm_short_limit", short_limit)
+    prof = CommProfiler(Trace()).install()
+    try:
+        def build(rank, ctx):
+            dc = LocalCollection("D", shape=(length_elems,), nodes=2, myrank=rank,
+                                 init=lambda k: np.full(length_elems, 3.0))
+            dc.rank_of = lambda *key: 0 if key[0] < nflows else 1
+
+            ptg = PTG("bw")
+            src = ptg.task_class("src", f="0 .. F-1")
+            src.affinity("D(f)")          # sources on rank 0
+            src.flow("X", INOUT, "<- D(f)", "-> X sink(f)")
+            src.body(cpu=lambda X, f: X.__iadd__(1.0))
+
+            sink = ptg.task_class("sink", f="0 .. F-1")
+            sink.affinity("D(F + f)")     # sinks on rank 1
+            sink.flow("X", IN, "<- X src(f)")
+            sink.body(cpu=lambda X, f: None)
+            return ptg.taskpool(F=nflows, D=dc)
+
+        run_ranks(2, build, timeout=60)
+        return prof.trace.to_dataframe()
+    finally:
+        prof.uninstall()
+        mca_param.set_param("runtime", "comm_short_limit", 1 << 16)
+
+
+def test_comm_trace_counts_large_payloads():
+    """check-comms.py shape: F=10 flows of L=2097152 bytes each via the
+    one-sided GET path; counts and byte sums must be exact."""
+    F, L_ELEMS = 10, 262144  # 262144 float64 = 2 MiB per payload
+    df = run_bandwidth(F, L_ELEMS, short_limit=1024)
+
+    act = df[df["name"] == "MPI_ACTIVATE"]
+    ctl = df[df["name"] == "MPI_DATA_CTL"]
+    pld = df[df["name"] == "MPI_DATA_PLD"]
+
+    # one activation per cross-rank dep, header length pinned:
+    # 4 * (4 words + 1 src local + 1 succ local) = 24 bytes each
+    assert len(act) == F
+    assert act["bytes"].sum() == F * 24
+    # every payload above the short limit advertises exactly one GET
+    assert len(ctl) == F
+    # payload bytes delivered: exactly F * 2 MiB, all via the get path
+    assert len(pld) == F
+    assert pld["bytes"].sum() == F * L_ELEMS * 8 == F * 2097152
+    assert set(pld["kind"]) == {"get"}
+
+
+def test_comm_trace_counts_inline_payloads():
+    """Below the short limit everything inlines: no DATA_CTL events, and
+    payload bytes still account exactly."""
+    F, L_ELEMS = 7, 16  # 128 B payloads
+    df = run_bandwidth(F, L_ELEMS, short_limit=1 << 16)
+
+    assert len(df[df["name"] == "MPI_ACTIVATE"]) == F
+    assert len(df[df["name"] == "MPI_DATA_CTL"]) == 0
+    pld = df[df["name"] == "MPI_DATA_PLD"]
+    assert len(pld) == F
+    assert pld["bytes"].sum() == F * L_ELEMS * 8
+    assert set(pld["kind"]) == {"inline"}
+
+
+def test_comm_trace_counts_dtd_channel():
+    """The DTD shadow-task wire is accounted too: a cross-rank DTD chain
+    of n hops must log n-1 tile shipments with exact byte sums."""
+    from parsec_tpu.dsl.dtd import AFFINITY, DTDTaskpool, INOUT
+    from tests.dsl.test_dtd_multirank import run_ranks as run_dtd_ranks
+
+    n, W = 8, 32  # 8 hops, 32 float64 = 256 B tiles (inline)
+    prof = CommProfiler(Trace()).install()
+    try:
+        def body(rank, ctx):
+            dc = LocalCollection("T", shape=(W,), nodes=2, myrank=rank,
+                                 init=lambda k: np.zeros(W))
+            dc.rank_of = lambda *key: dc.data_key(*key) % 2
+
+            dtd = DTDTaskpool(ctx, name="chain")
+            for k in range(n):
+                if k == 0:
+                    dtd.insert_task(lambda cur: None,
+                                    (dc.data_of(0), INOUT | AFFINITY))
+                else:
+                    def step(prev, cur):
+                        cur[:] = prev
+
+                    dtd.insert_task(step, (dc.data_of(k - 1), IN),
+                                    (dc.data_of(k), INOUT | AFFINITY))
+            dtd.flush_all()
+            dtd.close()
+            assert ctx.wait(timeout=60)
+
+        run_dtd_ranks(2, body)
+        df = prof.trace.to_dataframe()
+    finally:
+        prof.uninstall()
+
+    act = df[(df["name"] == "MPI_ACTIVATE") & (df["class"] == "dtd")]
+    pld = df[df["name"] == "MPI_DATA_PLD"]
+    # each hop k=1..n-1 ships tile k-1 to the other rank, plus flush
+    # traffic home; every shipped payload is W*8 bytes and inlines
+    assert len(act) == len(pld) >= n - 1
+    assert set(pld["kind"]) == {"inline"}
+    assert pld["bytes"].sum() == len(pld) * W * 8
+
+
+def test_comm_trace_dump_roundtrip(tmp_path):
+    """The dumped Perfetto JSON carries the comm dictionary + events."""
+    import json
+
+    F = 3
+    prof_df = run_bandwidth(F, 16, short_limit=1 << 16)
+    assert len(prof_df) >= 2 * F  # activations + payloads at least
+
+    # separate tiny run exercising dump()
+    t = Trace()
+    prof = CommProfiler(t).install()
+    prof.uninstall()
+    p = tmp_path / "comm.json"
+    t.dump(str(p))
+    doc = json.loads(p.read_text())
+    assert "MPI_ACTIVATE" in doc["metadata"]["dictionary"]
