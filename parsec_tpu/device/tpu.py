@@ -119,6 +119,16 @@ class TpuDevice(Device):
             help="number of round-robin async submission lanes")
         self._lanes: List[Deque[_InFlight]] = [collections.deque() for _ in range(self._nlanes)]
         self._rr = 0
+        #: eager completion: a single-controller JAX device queue already
+        #: orders computations by data dependencies, so successor release
+        #: does not need to wait for device events — the runtime completes
+        #: the task at dispatch and the whole DAG streams asynchronously
+        #: (one sync at taskpool wait). 0 restores reference-style per-lane
+        #: event polling (device_gpu.c:1879-1999), which pays a full
+        #: host<->device round-trip per completion.
+        self._eager = bool(mca_param.register(
+            "device", "tpu_eager_complete", 1,
+            help="complete device tasks at dispatch; 0 = poll lane events"))
         #: dual LRU of resident Data keyed by data_id (reference
         #: gpu_mem_lru / gpu_mem_owned_lru)
         self._lru_clean: "collections.OrderedDict[int, Data]" = collections.OrderedDict()
@@ -129,6 +139,7 @@ class TpuDevice(Device):
         #: slab, offset-based since PJRT owns the real device memory
         self._zone = None
         self._offsets: Dict[int, Tuple[int, int]] = {}  # data_id -> (off, nbytes)
+        self._accounted: Dict[int, int] = {}  # data_id -> accounted nbytes (non-zone)
         if mca_param.register("device", "tpu_native_zone", 1,
                               help="use the native zone allocator for HBM accounting"):
             try:
@@ -196,13 +207,17 @@ class TpuDevice(Device):
                 if task is None:
                     break
                 try:
-                    self._submit(task)
+                    self._submit(task, es)
                 except Exception as e:
                     debug.error("tpu submit of %r failed: %s", task, e)
                     import traceback
 
                     traceback.print_exc()
-                    scheduling.complete_execution(self.context, es, task)
+                    # eager _submit may have begun releasing successors
+                    # before raising — completing again would double-release
+                    # dependency counters
+                    if not getattr(task, "_tpu_completed", False):
+                        scheduling.complete_execution(self.context, es, task)
             # phase: get_data_out — retire ready computations in order
             progressed = self._poll_lanes(es)
             with self._lock:
@@ -222,7 +237,7 @@ class TpuDevice(Device):
     # ------------------------------------------------------------------
     # stage_in / submit
     # ------------------------------------------------------------------
-    def _submit(self, task: Task) -> None:
+    def _submit(self, task: Task, es=None) -> None:
         """kernel_push + body dispatch (reference device_gpu.c:2015-2164)."""
         body = task.selected_chore.body_fn
         if body is None:
@@ -255,9 +270,10 @@ class TpuDevice(Device):
                 dev_args.append(jnp.zeros(shape, dtype))
             # other kinds (e.g. "ctl") contribute no argument
 
-        jitted = self._jit_cache.get(body)
+        key = getattr(body, "_jit_key", body)
+        jitted = self._jit_cache.get(key)
         if jitted is None:
-            jitted = self._jit_cache[body] = jax.jit(body)
+            jitted = self._jit_cache[key] = jax.jit(body)
         outputs = jitted(*dev_args)
         if not isinstance(outputs, (tuple, list)):
             outputs = (outputs,)
@@ -266,9 +282,17 @@ class TpuDevice(Device):
             raise ValueError(
                 f"device body of {task!r} returned {len(outputs)} outputs "
                 f"for {len(out_specs)} writable flows")
+        inflight = _InFlight(task, outputs, out_specs)
+        if self._eager:
+            from ..core import scheduling
+
+            self._epilog(inflight)  # raising here falls back to the manager's error completion
+            task._tpu_completed = True
+            scheduling.complete_execution(self.context, es, task)
+            return
         lane = self._lanes[self._rr % self._nlanes]
         self._rr += 1
-        lane.append(_InFlight(task, outputs, out_specs))
+        lane.append(inflight)
 
     def _out_placeholder(self, data: Data) -> Any:
         """Device-side zeros standing in for a write-only tile."""
@@ -346,8 +370,16 @@ class TpuDevice(Device):
                     self._offsets[data.data_id] = (off, new_nbytes)
             self.hbm_used = self._zone.used
         else:
-            self._reserve(max(0, new_nbytes - old_nbytes))
-            self.hbm_used += new_nbytes - old_nbytes
+            # truth for what this device accounted lives in _accounted, not
+            # in the caller's view: copies attached from outside (e.g. a
+            # benchmark pre-placing tiles) enter the LRU via _stage_in
+            # without ever being accounted, and freeing them must not
+            # underflow the budget
+            old_acc = self._accounted.pop(data.data_id, 0)
+            self._reserve(max(0, new_nbytes - old_acc))
+            self.hbm_used += new_nbytes - old_acc
+            if new_nbytes > 0:
+                self._accounted[data.data_id] = new_nbytes
 
     def _hbm_free(self, data: Data, nbytes: int) -> None:
         if self._zone is not None:
@@ -356,7 +388,7 @@ class TpuDevice(Device):
                 self._zone.release(slot[0])
             self.hbm_used = self._zone.used
         else:
-            self.hbm_used -= nbytes
+            self.hbm_used -= self._accounted.pop(data.data_id, 0)
 
     def _drop_copy(self, data: Data) -> None:
         c = data.detach_copy(self.data_index)

@@ -684,6 +684,10 @@ def _wrap_device_body(pc: PTGTaskClass, fn: Callable):
         return fn(**dict(zip(names, pos)))
 
     wrapped.__name__ = getattr(fn, "__name__", pc.name)
+    # stable identity across taskpool instantiations: the device module's
+    # jit cache keys on this so one XLA compile serves every taskpool
+    # built from the same (body, flow-signature) pair
+    wrapped._jit_key = (fn, tuple(names))
     return wrapped
 
 

@@ -121,9 +121,8 @@ class Trace:
         return pd.DataFrame(rows)
 
 
-class TaskProfiler:
-    """PINS module feeding task lifecycle events into a Trace (reference
-    ``mca/pins/task_profiler``)."""
+class _PinsModule:
+    """Shared subscription lifecycle for PINS-backed trace modules."""
 
     def __init__(self, trace: Optional[Trace] = None):
         self.trace = trace or Trace()
@@ -132,6 +131,16 @@ class TaskProfiler:
     def _sub(self, site, cb):
         pins.subscribe(site, cb)
         self._subs.append((site, cb))
+
+    def uninstall(self) -> None:
+        for site, cb in self._subs:
+            pins.unsubscribe(site, cb)
+        self._subs.clear()
+
+
+class TaskProfiler(_PinsModule):
+    """PINS module feeding task lifecycle events into a Trace (reference
+    ``mca/pins/task_profiler``)."""
 
     def install(self) -> "TaskProfiler":
         t = self.trace
@@ -158,13 +167,8 @@ class TaskProfiler:
         self._sub(pins.COMPLETE_EXEC_END, e)
         return self
 
-    def uninstall(self) -> None:
-        for site, cb in self._subs:
-            pins.unsubscribe(site, cb)
-        self._subs.clear()
 
-
-class CommProfiler:
+class CommProfiler(_PinsModule):
     """PINS module feeding comm-protocol events into a Trace (reference:
     the comm thread's profiling stream logging MPI_ACTIVATE /
     MPI_DATA_CTL / MPI_DATA_PLD, ``remote_dep_mpi.c:1198-1200``). Events
@@ -173,10 +177,6 @@ class CommProfiler:
 
     #: trace-event names, kept reference-compatible for the validators
     ACTIVATE, DATA_CTL, DATA_PLD = "MPI_ACTIVATE", "MPI_DATA_CTL", "MPI_DATA_PLD"
-
-    def __init__(self, trace: Optional[Trace] = None):
-        self.trace = trace or Trace()
-        self._subs = []
 
     def install(self) -> "CommProfiler":
         t = self.trace
@@ -188,14 +188,8 @@ class CommProfiler:
             def cb(es, info, name=name):
                 t.instant(name, tid="comm", **(info or {}))
 
-            pins.subscribe(site, cb)
-            self._subs.append((site, cb))
+            self._sub(site, cb)
         return self
-
-    def uninstall(self) -> None:
-        for site, cb in self._subs:
-            pins.unsubscribe(site, cb)
-        self._subs.clear()
 
 
 def _tid(es) -> Any:
