@@ -140,6 +140,7 @@ class Task:
         "on_complete",
         "prof",
         "user",
+        "_tpu_completed",
     )
 
     def __init__(
@@ -173,6 +174,10 @@ class Task:
         self.on_complete: Optional[Callable[["Task"], None]] = None
         self.prof: Dict[str, float] = {}
         self.user: Any = None
+        #: set by the TPU device module once its eager-completion path has
+        #: retired the task (guards the manager's error-containment fallback
+        #: against double-completion)
+        self._tpu_completed = False
 
     @property
     def key(self) -> Any:

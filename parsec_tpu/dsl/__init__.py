@@ -1,5 +1,7 @@
-"""DSL front-ends (reference L5): DTD dynamic insertion, PTG builder."""
+"""DSL front-ends (reference L5): DTD dynamic insertion, PTG builder,
+JDF file compiler (``parsec_ptgpp`` analogue)."""
 
+from .jdf import JDF, compile_jdf, compile_jdf_file
 from .ptg import PTG, PTGTaskClass, PTGTaskpool
 from .dtd import (
     AFFINITY,
@@ -15,6 +17,9 @@ from .dtd import (
 )
 
 __all__ = [
+    "JDF",
+    "compile_jdf",
+    "compile_jdf_file",
     "PTG",
     "PTGTaskClass",
     "PTGTaskpool",

@@ -81,6 +81,36 @@ _SAFE_BUILTINS = {
 }
 
 
+def _c_to_py(src: str) -> str:
+    """Accept the C boolean operators of reference JDF expressions
+    (``parsec.y`` expr grammar): ``&&`` → ``and``, ``||`` → ``or``,
+    ``!`` → ``not`` (but not ``!=``). Everything else is Python.
+    String literals pass through untouched."""
+    out: List[str] = []
+    i, n = 0, len(src)
+    while i < n:
+        ch = src[i]
+        if ch in "\"'":
+            j = i + 1
+            while j < n and src[j] != ch:
+                j += 2 if src[j] == "\\" else 1
+            out.append(src[i : min(j + 1, n)])
+            i = j + 1
+        elif src.startswith("&&", i):
+            out.append(" and ")
+            i += 2
+        elif src.startswith("||", i):
+            out.append(" or ")
+            i += 2
+        elif ch == "!" and not src.startswith("!=", i):
+            out.append(" not ")
+            i += 1
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
 class _Expr:
     """A compiled Python expression over task params + constants."""
 
@@ -88,7 +118,7 @@ class _Expr:
 
     def __init__(self, src: str):
         self.src = src.strip()
-        self.code = compile(self.src, f"<ptg:{self.src}>", "eval")
+        self.code = compile(_c_to_py(self.src), f"<ptg:{self.src}>", "eval")
 
     def __call__(self, env: Dict[str, Any]) -> Any:
         return eval(self.code, {"__builtins__": _SAFE_BUILTINS}, env)
@@ -218,10 +248,28 @@ def _parse_dep(spec: str) -> _Dep:
     props: Dict[str, str] = {}
     pm = re.search(r"\[(.*?)\]\s*$", spec)
     if pm:
-        for kv in pm.group(1).split():
+        # JDF property blocks allow spaces around '=' and parenthesized
+        # values with internal spaces: normalize, then split at depth 0
+        body = re.sub(r"\s*=\s*", "=", pm.group(1).strip())
+        depth, cur = 0, []
+        tokens: List[str] = []
+        for ch in body:
+            if ch in "([":
+                depth += 1
+            elif ch in ")]":
+                depth -= 1
+            if ch.isspace() and depth == 0:
+                if cur:
+                    tokens.append("".join(cur))
+                    cur = []
+            else:
+                cur.append(ch)
+        if cur:
+            tokens.append("".join(cur))
+        for kv in tokens:
             if "=" in kv:
                 k, v = kv.split("=", 1)
-                props[k] = v
+                props[k] = v.strip('"').strip("'")
         spec = spec[: pm.start()].strip()
     if spec.startswith("<-"):
         is_input, rest = True, spec[2:].strip()
@@ -263,17 +311,46 @@ class _PTGFlow:
 
 
 class PTGTaskClass:
-    """Declarative task class (reference ``jdf_function_entry_t``)."""
+    """Declarative task class (reference ``jdf_function_entry_t``).
+
+    Locals come in two kinds, in declaration order (reference ``jdf_def_t``
+    list, ``parsec.y`` "definitions"): **parameters** (named in the task
+    heading, each with an integer range — they form the task key) and
+    **definitions** (derived scalars like ``m = t % NT``, usable in later
+    ranges, dependencies, affinity, priority, and the body — the reference
+    stencil JDF interleaves them between parameter ranges)."""
 
     def __init__(self, ptg: "PTG", name: str, params: Dict[str, str]):
         self.ptg = ptg
         self.name = name
-        self.param_names: List[str] = list(params)
-        self.param_ranges: List[_ArgExpr] = [_ArgExpr(v) for v in params.values()]
+        # (name, expr, is_param) in declaration order
+        self.decls: List[Tuple[str, _ArgExpr, bool]] = [
+            (k, _ArgExpr(v), True) for k, v in params.items()
+        ]
         self.flows: List[_PTGFlow] = []
         self._affinity: Optional[_DataRef] = None
         self._priority: Optional[_Expr] = None
         self.bodies: Dict[str, Callable] = {}
+        self.properties: Dict[str, Any] = {}
+
+    @property
+    def param_names(self) -> List[str]:
+        return [n for n, _, p in self.decls if p]
+
+    @property
+    def def_names(self) -> List[str]:
+        return [n for n, _, p in self.decls if not p]
+
+    def define(self, name: str, expr: str) -> "PTGTaskClass":
+        """Append a derived-local definition (JDF ``name = expr`` line)."""
+        self.decls.append((name, _ArgExpr(expr), False))
+        return self
+
+    def param(self, name: str, range_src: str) -> "PTGTaskClass":
+        """Append a parameter range in declaration order (JDF ``k = lo..hi``
+        for a name listed in the task heading)."""
+        self.decls.append((name, _ArgExpr(range_src), True))
+        return self
 
     def affinity(self, spec: str) -> "PTGTaskClass":
         t = _parse_target(spec)
@@ -308,29 +385,44 @@ class PTGTaskClass:
 
     # -- evaluation over a constants dict --------------------------------
     def env_of(self, locals_: Tuple, constants: Dict[str, Any]) -> Dict[str, Any]:
+        """Bind params from the task key and evaluate definitions in
+        declaration order (definitions may reference earlier locals)."""
         env = dict(constants)
-        env.update(zip(self.param_names, locals_))
+        it = iter(locals_)
+        for name, expr, is_param in self.decls:
+            env[name] = next(it) if is_param else expr.scalar(env)
         return env
 
     def param_space(self, constants: Dict[str, Any]) -> Iterable[Tuple]:
-        def rec(i: int, acc: Tuple):
-            if i == len(self.param_names):
+        def rec(i: int, env: Dict[str, Any], acc: Tuple):
+            if i == len(self.decls):
                 yield acc
                 return
-            env = dict(constants)
-            env.update(zip(self.param_names, acc))
-            for v in self.param_ranges[i].values(env):
-                yield from rec(i + 1, acc + (v,))
+            name, expr, is_param = self.decls[i]
+            if is_param:
+                for v in expr.values(env):
+                    e2 = dict(env)
+                    e2[name] = v
+                    yield from rec(i + 1, e2, acc + (v,))
+            else:
+                e2 = dict(env)
+                e2[name] = expr.scalar(env)
+                yield from rec(i + 1, e2, acc)
 
-        yield from rec(0, ())
+        yield from rec(0, dict(constants), ())
 
     def valid(self, locals_: Tuple, constants: Dict[str, Any]) -> bool:
         env = dict(constants)
-        for name, rng, v in zip(self.param_names, self.param_ranges, locals_):
-            vals = rng.values(env)
-            if v not in (vals if isinstance(vals, range) else tuple(vals)):
-                return False
-            env[name] = v
+        it = iter(locals_)
+        for name, expr, is_param in self.decls:
+            if is_param:
+                v = next(it)
+                vals = expr.values(env)
+                if v not in (vals if isinstance(vals, range) else tuple(vals)):
+                    return False
+                env[name] = v
+            else:
+                env[name] = expr.scalar(env)
         return True
 
     def active_input(self, f: _PTGFlow, env: Dict[str, Any]):
@@ -501,8 +593,8 @@ class PTGTaskpool(Taskpool):
                         data = materialize(get_copy_reshape(data, rspec))
                 specs.append(("data", data, f.mode))
                 task.data_in[f.index] = data.newest_copy() if data is not None else None
-            for name, v in zip(pc.param_names, task.locals):
-                specs.append(("value", v, AccessMode.VALUE))
+            for name in pc.param_names + pc.def_names:
+                specs.append(("value", env[name], AccessMode.VALUE))
             task.body_args = specs
             return HookReturn.DONE
 
@@ -678,7 +770,7 @@ def _accel_hook(es, task):
 def _wrap_device_body(pc: PTGTaskClass, fn: Callable):
     """The device module passes positional args (non-CTL flows, then
     params); re-map to the uniform keyword signature body(FLOW=..., k=...)."""
-    names = [f.name for f in pc.flows if f.mode != CTL] + pc.param_names
+    names = [f.name for f in pc.flows if f.mode != CTL] + pc.param_names + pc.def_names
 
     def wrapped(*pos):
         return fn(**dict(zip(names, pos)))
@@ -709,7 +801,8 @@ def _make_cpu_hook(pc: PTGTaskClass, fn: Callable):
             kw[f.name] = arr
             if f.mode & AccessMode.OUT:
                 writable.append(data)
-        kw.update(zip(pc.param_names, task.locals))
+        values = [s[1] for s in task.body_args if s[0] == "value"]
+        kw.update(zip(pc.param_names + pc.def_names, values))
         result = fn(**kw)
         if result is not None:
             outs = result if isinstance(result, (tuple, list)) else (result,)

@@ -82,11 +82,11 @@ def replay_via_dtd(
                 continue
             args.append((tile_for(source_tile(g, tid, f.name)), f.mode))
             kw_order.append(f.name)
-        for pname, v in zip(pc.param_names, locs):
-            args.append((v, VALUE))
+        env = pc.env_of(locs, consts)
+        for pname in pc.param_names + pc.def_names:
+            args.append((env[pname], VALUE))
             kw_order.append(pname)
         # control edges: consume producers' dummy tiles, publish my own
-        env = pc.env_of(locs, consts)
         for f in pc.flows:
             if f.mode != CTL:
                 continue

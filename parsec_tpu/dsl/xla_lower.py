@@ -102,7 +102,8 @@ class GraphExecutor:
                     if hk not in seen_in:
                         seen_in.add(hk)
                         homes_in.append(hk)
-            params = dict(zip(pc.param_names, tid[1]))
+            penv = pc.env_of(tid[1], tp.constants)
+            params = {n: penv[n] for n in pc.param_names + pc.def_names}
             wbs = [(fn_, cn, tuple(k)) for (fn_, cn, k) in node.write_backs]
             for (_fn, cn, k) in wbs:
                 hk = (cn, k)

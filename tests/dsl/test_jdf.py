@@ -1,0 +1,516 @@
+"""JDF file front-end tests (reference: the ptgpp compiler testsuite under
+tests/dsl/ptg/ptgpp and the tutorial .jdf examples)."""
+
+import importlib.util
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl import compile_jdf, compile_jdf_file
+from parsec_tpu.dsl.jdf import JDFSyntaxError
+from parsec_tpu.dsl.jdfc import generate, main as jdfc_main
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "..", "examples", "jdf")
+
+
+@pytest.fixture
+def ctx():
+    c = Context(nb_cores=4)
+    yield c
+    c.fini()
+
+
+def _run(ctx, tp, timeout=60):
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=timeout)
+
+
+CHAIN = """
+extern "C" %{
+BUMP = 2.0
+%}
+
+mydata  [ type = "collection" ]
+NB      [ type = int ]
+
+Task(k)
+
+k = 0 .. NB
+
+: mydata( k )
+
+RW  A <- (k == 0)  ? mydata( k ) : A Task( k-1 )
+      -> (k == NB) ? mydata( k ) : A Task( k+1 )
+
+BODY
+{
+    A += BUMP
+}
+END
+"""
+
+
+def test_chain_compile_and_run(ctx):
+    """Ex04_ChainData shape: NB+1 chained increments of one datum."""
+    jdf = compile_jdf(CHAIN, "chain")
+    dc = LocalCollection("mydata", shape=(1,), init=lambda k: np.zeros(1))
+    tp = jdf.new(mydata=dc, NB=9)
+    _run(ctx, tp)
+    np.testing.assert_allclose(dc.data_of(0).newest_copy().payload, 10 * 2.0)
+
+
+def test_chain_example_file(ctx):
+    jdf = compile_jdf_file(os.path.join(EXAMPLES, "chaindata.jdf"))
+    dc = LocalCollection("mydata", shape=(1,), init=lambda k: np.zeros(1))
+    tp = jdf.new(mydata=dc, NB=4)
+    _run(ctx, tp)
+    np.testing.assert_allclose(dc.data_of(0).newest_copy().payload, 5.0)
+
+
+def test_required_globals():
+    jdf = compile_jdf(CHAIN, "chain")
+    assert jdf.required_globals() == ["mydata", "NB"]
+    with pytest.raises(TypeError, match="missing globals"):
+        jdf.new(NB=3)
+
+
+def test_definitions_interleaved_and_priority(ctx):
+    """Derived locals between parameter ranges (stencil_1D.jdf shape:
+    `m = t %% descA->lmt` sits between the ranges of t and n) and a
+    priority expression; definitions are visible in deps and the body.
+
+    Note `%%{ i // 2 %%}`: outside inline escapes `//` is a C comment
+    (JDF grammar), so Python floor division must ride an escape."""
+    src = """
+D   [ type = "collection" ]
+N   [ type = int ]
+
+t(i, j)
+
+i = 0 .. N-1
+half = %{ i // 2 %}
+j = 0 .. half
+tag = i * 10 + j
+
+: D( i )
+
+RW X <- D( i )
+     -> D( i )
+
+; 100 - tag
+
+BODY
+{
+    X[:] = tag
+}
+END
+"""
+    jdf = compile_jdf(src, "defs")
+    seen = {}
+    dc = LocalCollection("D", shape=(1,), init=lambda k: np.zeros(1))
+    tp = jdf.new(D=dc, N=5)
+    # execution space: i in 0..4, j in 0..i//2
+    tids = [tid for tid in tp.ptg.classes["t"].param_space(tp.constants)]
+    assert tids == [(i, j) for i in range(5) for j in range(i // 2 + 1)]
+    ctx2 = Context(nb_cores=2)
+    try:
+        _run(ctx2, tp)
+    finally:
+        ctx2.fini()
+    # last writer wins on the shared tile; just check the body saw `tag`
+    v = dc.data_of(4).newest_copy().payload[0]
+    assert v in {40.0, 41.0, 42.0}
+
+
+def test_prologue_helpers_and_inline_escapes(ctx):
+    src = """
+%{
+def double(x):
+    return 2 * x
+BASE = 5
+%}
+
+D   [ type = "collection" ]
+N   [ type = int default = %{ BASE - 2 %} ]
+
+t(k)
+
+k = 0 .. N-1
+kk = %{ double(k) %}
+
+: D( k )
+
+RW X <- D( k )
+     -> D( k )
+
+BODY
+{
+    X[:] = kk
+}
+END
+"""
+    jdf = compile_jdf(src, "helpers")
+    dc = LocalCollection("D", shape=(1,), init=lambda k: np.zeros(1))
+    tp = jdf.new(D=dc)  # N defaults to BASE - 2 == 3
+    _run(ctx, tp)
+    for k in range(3):
+        np.testing.assert_allclose(dc.data_of(k).newest_copy().payload, 2.0 * k)
+
+
+def test_ctl_gather_and_range_broadcast(ctx):
+    """Range output dep fans out; CTL flow gathers the fan back in."""
+    src = """
+D   [ type = "collection" ]
+N   [ type = int ]
+
+src()
+
+: D( 0 )
+
+RW X <- D( 0 )
+     -> X work( 0 .. N-1 )
+
+BODY
+{
+    X += 1.0
+}
+END
+
+work(w)
+
+w = 0 .. N-1
+
+: D( 0 )
+
+READ X <- X src()
+CTL  c -> c sink()
+
+BODY
+{
+    pass
+}
+END
+
+sink()
+
+: D( 0 )
+
+CTL c <- c work( 0 .. N-1 )
+
+BODY
+{
+    pass
+}
+END
+"""
+    jdf = compile_jdf(src, "gather")
+    dc = LocalCollection("D", shape=(2,), init=lambda k: np.zeros(2))
+    tp = jdf.new(D=dc, N=6)
+    _run(ctx, tp)
+
+
+def test_c_operators_in_guards(ctx):
+    """Reference JDF guards use C && / || / ! — accepted verbatim."""
+    src = """
+D   [ type = "collection" ]
+N   [ type = int ]
+
+t(k)
+
+k = 0 .. N-1
+
+: D( k )
+
+RW X <- (k == 0 || !(k > 0)) ? D( k ) : D( k )
+     -> (k >= 0 && k < N) ? D( k ) : NONE
+
+BODY
+{
+    X += 1.0
+}
+END
+"""
+    jdf = compile_jdf(src, "cops")
+    dc = LocalCollection("D", shape=(1,), init=lambda k: np.zeros(1))
+    tp = jdf.new(D=dc, N=3)
+    _run(ctx, tp)
+    for k in range(3):
+        np.testing.assert_allclose(dc.data_of(k).newest_copy().payload, 1.0)
+
+
+def test_dep_properties_preserved():
+    """`[ type_remote = LR ]` property blocks parse (spaces around '=')
+    and land on the dep."""
+    jdf = compile_jdf_file(os.path.join(EXAMPLES, "stencil_1d.jdf"))
+    pc = jdf.ptg.classes["step"]
+    al = next(f for f in pc.flows if f.name == "AL")
+    assert al.deps_in[0].props.get("type_remote") == "LR"
+
+
+def test_stencil_example_runs(ctx):
+    """The stencil JDF runs to completion and matches a NumPy simulation
+    of the same update rule (cpu body)."""
+    NT, ITER, W = 4, 3, 8
+    jdf = compile_jdf_file(os.path.join(EXAMPLES, "stencil_1d.jdf"))
+    init = {n: np.arange(W, dtype=float) + 10.0 * n for n in range(NT)}
+    # ping-pong buffer rows: row 0 holds the initial data
+    dc = LocalCollection(
+        "descA", shape=(W,), init=lambda k: init[k[1]].copy() if k[0] == 0
+        else np.zeros(W))
+    tp = jdf.new(descA=dc, NT=NT, ITER=ITER)
+    _run(ctx, tp)
+
+    # replay the same dataflow in plain numpy
+    prev = [init[n].copy() for n in range(NT)]
+    for t in range(1, ITER + 1):
+        cur = []
+        for n in range(NT):
+            AL = prev[n - 1] if (t > 1 and n > 0) else None
+            AR = prev[n + 1] if (t > 1 and n < NT - 1) else None
+            acc, cnt = prev[n] * 0.5, 2.0
+            if AL is not None:
+                acc = acc + AL[-1] * 0.25
+                cnt += 1.0
+            if AR is not None:
+                acc = acc + AR[0] * 0.25
+                cnt += 1.0
+            cur.append(acc * (4.0 / cnt))
+        prev = cur
+    for n in range(NT):
+        np.testing.assert_allclose(
+            dc.data_of(ITER % 2, n).newest_copy().payload, prev[n], rtol=1e-6)
+
+
+def test_device_body(ctx):
+    """BODY [type=tpu] — a functional incarnation executed by the device
+    module (jax.jit) returning the new value of the writable flow."""
+    src = """
+D   [ type = "collection" ]
+
+t(k)
+
+k = 0 .. 2
+
+: D( k )
+
+RW X <- D( k )
+     -> D( k )
+
+BODY [ type = tpu ]
+{
+    return X * 2.0 + k
+}
+END
+"""
+    jdf = compile_jdf(src, "dev")
+    dc = LocalCollection("D", shape=(4,), init=lambda k: np.full(4, 1.0 + k))
+    tp = jdf.new(D=dc)
+    _run(ctx, tp)
+    from parsec_tpu.dsl.dtd import stage_to_cpu
+
+    for k in range(3):
+        np.testing.assert_allclose(stage_to_cpu(dc.data_of(k)), (1.0 + k) * 2 + k)
+
+
+def test_multirank_chain():
+    """The chain JDF distributed over 2 ranks (reference runs Ex04 under
+    mpiexec): affinity mydata(k) alternates ranks, activations ride the
+    comm engine."""
+    from tests.runtime.test_multirank import run_ranks
+
+    NB = 7
+    finals = {}
+
+    def build(rank, ctx):
+        dc = LocalCollection("mydata", shape=(1,), nodes=2, myrank=rank,
+                             init=lambda k: np.zeros(1))
+        dc.rank_of = lambda *key: (key[0] if key else 0) % 2
+        jdf = compile_jdf(CHAIN, "chain")
+        tp = jdf.new(mydata=dc, NB=NB)
+        finals[rank] = dc
+        return tp
+
+    run_ranks(2, build)
+    # last task k=NB owned by rank NB%2 writes the final value home
+    dc = finals[NB % 2]
+    np.testing.assert_allclose(
+        dc.data_of(NB).newest_copy().payload, (NB + 1) * 2.0)
+
+
+def test_python_operators_survive_comment_stripping(ctx):
+    """`//` is a C comment in structural text but floor division inside
+    BODY blocks and %{ %} escapes; `!`/`&&` inside string literals of
+    expressions must pass through untouched."""
+    src = """
+D   [ type = "collection" ]
+
+t(k)   /* block comment
+          spanning lines */
+
+k = 0 .. 3          // trailing comment
+half = %{ k // 2 %} // escape keeps floor division
+
+: D( k )
+
+RW X <- D( k )
+     -> D( k )
+
+BODY
+{
+    # Python comment with // and && inside the body
+    q = k // 2
+    assert q == half, "bang! && bars || survive in strings"
+    X[:] = q
+}
+END
+"""
+    jdf = compile_jdf(src, "ops")
+    dc = LocalCollection("D", shape=(1,), init=lambda k: np.zeros(1))
+    tp = jdf.new(D=dc)
+    _run(ctx, tp)
+    for k in range(4):
+        np.testing.assert_allclose(dc.data_of(k).newest_copy().payload, k // 2)
+
+
+def test_high_priority_property():
+    src = """
+D [ type = "collection" ]
+
+t(k) [ high_priority = on ]
+
+k = 0 .. 1
+
+: D( k )
+
+RW X <- D( k )
+     -> D( k )
+
+BODY
+{
+    pass
+}
+END
+"""
+    jdf = compile_jdf(src, "hp")
+    pc = jdf.ptg.classes["t"]
+    assert pc.properties.get("high_priority") == "on"
+    assert pc.priority_of((0,), {}) == 1 << 20
+
+
+def test_ptg_to_dtd_replay_with_definitions(ctx):
+    """The DTD replay harness passes derived definitions to bodies too."""
+    from parsec_tpu.dsl.ptg_to_dtd import replay_via_dtd
+
+    src = """
+D [ type = "collection" ]
+N [ type = int ]
+
+t(k)
+
+k = 0 .. N-1
+kk = k * 2
+
+: D( k )
+
+RW X <- D( k )
+     -> D( k )
+
+BODY
+{
+    X[:] = kk
+}
+END
+"""
+    jdf = compile_jdf(src, "replay")
+    dc = LocalCollection("D", shape=(1,), init=lambda k: np.zeros(1))
+    tp = jdf.new(D=dc, N=4)
+    replay_via_dtd(tp, ctx)
+    for k in range(4):
+        np.testing.assert_allclose(dc.data_of(k).newest_copy().payload, 2.0 * k)
+
+
+# ---------------------------------------------------------------------------
+# error reporting
+# ---------------------------------------------------------------------------
+
+def test_error_missing_range():
+    with pytest.raises(JDFSyntaxError, match="have no range"):
+        compile_jdf("t(k)\n: D(0)\nBODY\npass\nEND\n", "bad")
+
+
+def test_error_missing_body():
+    with pytest.raises(JDFSyntaxError):
+        compile_jdf("t(k)\nk = 0 .. 3\n: D(k)\nRW X <- D(k)\n", "bad")
+
+
+def test_error_heading_order():
+    src = "t(a, b)\nb = 0 .. 1\na = 0 .. 1\n: D(a)\nBODY\npass\nEND\n"
+    with pytest.raises(JDFSyntaxError, match="heading order"):
+        compile_jdf(src, "bad")
+
+
+def test_error_duplicate_body():
+    src = "t(k)\nk = 0 .. 1\n: D(k)\nBODY\npass\nEND\nBODY\npass\nEND\n"
+    with pytest.raises(ValueError, match="duplicate BODY"):
+        compile_jdf(src, "bad")
+
+
+# ---------------------------------------------------------------------------
+# codegen (jdfc)
+# ---------------------------------------------------------------------------
+
+def _import_generated(path, name):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.modules.pop(name, None)
+    return mod
+
+
+def test_jdfc_codegen_roundtrip(tmp_path, ctx):
+    """generate() emits a runnable Python module whose taskpool computes
+    the same result as the runtime-compiled JDF (ptgpp → C parity)."""
+    src_py = generate(CHAIN, "chain", source="chain.jdf")
+    out = tmp_path / "chain_ptg.py"
+    out.write_text(src_py)
+    mod = _import_generated(str(out), "chain_ptg_generated")
+    dc = LocalCollection("mydata", shape=(1,), init=lambda k: np.zeros(1))
+    tp = mod.new(mydata=dc, NB=9)
+    _run(ctx, tp)
+    np.testing.assert_allclose(dc.data_of(0).newest_copy().payload, 20.0)
+    with pytest.raises(TypeError, match="missing globals"):
+        mod.new(NB=1)
+
+
+def test_jdfc_cli(tmp_path, capsys):
+    jdf_path = tmp_path / "chain.jdf"
+    jdf_path.write_text(CHAIN)
+    out_path = tmp_path / "gen.py"
+    assert jdfc_main([str(jdf_path), "-o", str(out_path)]) == 0
+    assert out_path.exists()
+    assert "def new(" in out_path.read_text()
+    assert jdfc_main(["--check", str(jdf_path)]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_jdfc_stencil_roundtrip(tmp_path):
+    with open(os.path.join(EXAMPLES, "stencil_1d.jdf")) as f:
+        text = f.read()
+    src_py = generate(text, "stencil_1d", source="stencil_1d.jdf")
+    out = tmp_path / "stencil_ptg.py"
+    out.write_text(src_py)
+    mod = _import_generated(str(out), "stencil_ptg_generated")
+    dc = LocalCollection("descA", shape=(4,), init=lambda k: np.zeros(4))
+    tp = mod.new(descA=dc, NT=2, ITER=2)
+    ctx2 = Context(nb_cores=2)
+    try:
+        _run(ctx2, tp)
+    finally:
+        ctx2.fini()
