@@ -1,7 +1,11 @@
-"""Device layer (reference L4): registry, selection, CPU + TPU modules."""
+"""Device layer (reference L4): registry, selection, CPU + TPU modules,
+template skeleton for new backends."""
 
 from . import device
 from .device import CpuDevice, Device, select_best_device
 from . import tpu  # registers the TPU device component when JAX is present
+from . import template  # skeleton backend (inert unless enabled)
+from .template import TemplateDevice
 
-__all__ = ["device", "Device", "CpuDevice", "select_best_device", "tpu"]
+__all__ = ["device", "Device", "CpuDevice", "select_best_device", "tpu",
+           "template", "TemplateDevice"]

@@ -111,9 +111,13 @@ def attach_devices(context: "Context", names: Optional[List[str]] = None) -> Lis
 
     devices: List[Device] = []
     for cls in components_of_type("device"):
-        if sel is not None and cls.mca_name not in sel and cls.mca_name != "cpu":
+        explicit = sel is not None and cls.mca_name in sel
+        if sel is not None and not explicit and cls.mca_name != "cpu":
             continue
-        if not cls.available():
+        # explicit naming trumps the availability probe (a module that is
+        # inert by default, like template, still attaches when asked for;
+        # a truly missing backend fails in attach() and is skipped below)
+        if not cls.available() and not explicit:
             continue
         try:
             dev = cls(context, len(devices))

@@ -330,7 +330,12 @@ class _Parser:
     def _parse_prologue(self) -> None:
         line = self._next()
         idx = line.find("%{")
-        chunks = [line[idx + 2:]]
+        rest = line[idx + 2:]
+        end = rest.find("%}")
+        if end >= 0:  # single-line %{ ... %} block
+            self.ast.prologues.append(rest[:end].strip())
+            return
+        chunks = [rest]
         start = self.lineno
         while True:
             if self.pos >= len(self.lines):
@@ -505,8 +510,10 @@ class JDF:
         for g in self.ast.globals:
             if g.has_default:
                 try:
+                    # defaults see the prologue names AND earlier globals'
+                    # defaults (ptg.constants accumulates in order)
                     ptg.constants[g.name] = eval(  # noqa: S307 - trusted source
-                        g.props["default"], dict(self.namespace))
+                        g.props["default"], dict(ptg.constants))
                 except Exception as e:
                     raise ValueError(
                         f"global {g.name}: bad default {g.props['default']!r}: {e}")

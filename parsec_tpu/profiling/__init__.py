@@ -1,10 +1,13 @@
 """Observability layer (reference L7): PINS hooks, trace, DOT grapher,
-live properties dictionary."""
+live properties dictionary, SDE counters, alperf."""
 
 from . import pins
 from .trace import CommProfiler, TaskProfiler, Trace
 from .grapher import DotGrapher
 from . import dictionary
+from . import sde
+from .alperf import AlperfModule
+from .sde import SDEModule
 
 __all__ = ["pins", "Trace", "TaskProfiler", "CommProfiler", "DotGrapher",
-           "dictionary"]
+           "dictionary", "sde", "SDEModule", "AlperfModule"]

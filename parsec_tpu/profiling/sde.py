@@ -1,0 +1,111 @@
+"""Software-Defined Events — internal counters exposed by name.
+
+Reference: ``/root/reference/parsec/papi_sde.c`` registers runtime
+counters (tasks enabled/retired, scheduler queue lengths) as PAPI
+Software-Defined Events (``PARSEC_PAPI_SDE_COUNTER_ADD`` call sites in
+``scheduling.c:297-304,458``), so external profilers can read them by
+name (``PARSEC::SCHEDULER::PENDING_TASKS`` etc.).
+
+Here the registry is process-local: named monotonic/level counters with
+``add``/``set`` semantics, readable by any monitor (and auto-published
+into the live-properties :mod:`parsec_tpu.profiling.dictionary`).  The
+:class:`SDEModule` PINS subscriber maintains the reference's standard
+counter set from the scheduling callback sites; overhead is zero unless
+enabled (PINS fire is gated on subscribers).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+from . import dictionary, pins
+
+# the reference's standard counter names (papi_sde.c)
+TASKS_ENABLED = "PARSEC::TASKS_ENABLED"
+TASKS_RETIRED = "PARSEC::TASKS_RETIRED"
+PENDING_TASKS = "PARSEC::SCHEDULER::PENDING_TASKS"
+
+_lock = threading.Lock()
+_counters: Dict[str, float] = {}
+
+
+def register_counter(name: str, initial: float = 0) -> None:
+    with _lock:
+        _counters.setdefault(name, initial)
+    dictionary.register_property(f"sde.{name}", lambda n=name: read(n))
+
+
+def unregister_counter(name: str) -> None:
+    with _lock:
+        _counters.pop(name, None)
+    dictionary.unregister_property(f"sde.{name}")
+
+
+def counter_add(name: str, value: float) -> None:
+    """Reference ``PARSEC_PAPI_SDE_COUNTER_ADD`` semantics: create on
+    first use, accumulate."""
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + value
+
+
+def counter_set(name: str, value: float) -> None:
+    with _lock:
+        _counters[name] = value
+
+
+def read(name: str) -> float:
+    with _lock:
+        return _counters.get(name, 0)
+
+
+def list_counters() -> List[str]:
+    with _lock:
+        return sorted(_counters)
+
+
+def reset() -> None:
+    with _lock:
+        _counters.clear()
+
+
+class SDEModule:
+    """PINS subscriber maintaining the standard runtime counters.
+
+    * ``TASKS_ENABLED``  — tasks pushed to the scheduler (monotonic);
+    * ``TASKS_RETIRED``  — tasks whose completion retired (monotonic);
+    * ``PENDING_TASKS``  — enabled minus selected (a queue-length level).
+    """
+
+    def __init__(self):
+        for name in (TASKS_ENABLED, TASKS_RETIRED, PENDING_TASKS):
+            register_counter(name)
+        self._subs = [
+            # SCHEDULE_BEGIN sees the full batch — the keep-next-task fast
+            # path (scheduling.schedule_ready) pops the best task before
+            # SCHEDULE_END and hands it to the worker without a scheduler
+            # round-trip, so END undercounts
+            (pins.SCHEDULE_BEGIN, self._on_schedule),
+            # a kept task never passes SELECT either: drain "pending" when
+            # execution actually begins
+            (pins.EXEC_BEGIN, self._on_exec),
+            (pins.COMPLETE_EXEC_END, self._on_retire),
+        ]
+        for site, cb in self._subs:
+            pins.subscribe(site, cb)
+
+    # -- callbacks -------------------------------------------------------
+    def _on_schedule(self, es, batch) -> None:
+        n = len(batch) if isinstance(batch, (list, tuple)) else 1
+        counter_add(TASKS_ENABLED, n)
+        counter_add(PENDING_TASKS, n)
+
+    def _on_exec(self, es, task) -> None:
+        counter_add(PENDING_TASKS, -1)
+
+    def _on_retire(self, es, task) -> None:
+        counter_add(TASKS_RETIRED, 1)
+
+    def disable(self) -> None:
+        for site, cb in self._subs:
+            pins.unsubscribe(site, cb)

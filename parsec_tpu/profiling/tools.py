@@ -1,0 +1,158 @@
+"""Offline trace tools (CLI) — reference ``tools/profiling/``.
+
+The reference ships C readers for its binary ``.prof`` traces
+(``dbpreader.c``, ``dbpinfos.c``, ``dbp2xml.c``, ``dbp2mem.c``) plus a
+Python/Cython pandas stack (``pbt2ptt.pyx`` → ``profile2h5.py``).  This
+module is the equivalent over the framework's Chrome/Perfetto JSON traces:
+
+* ``info``    — summary a la ``dbpinfos``: ranks, threads, dictionary,
+  event counts/durations per class;
+* ``to-csv``  — flatten spans to CSV via the pandas converter
+  (``profile2h5`` analogue; CSV instead of HDF5 so no optional deps);
+* ``check-comms`` — the comm-protocol validator of
+  ``tests/profiling/check-comms.py``: assert exact counts / byte sums of
+  MPI_ACTIVATE / MPI_DATA_CTL / MPI_DATA_PLD events.
+
+Usage::
+
+    python -m parsec_tpu.profiling.tools info trace.json
+    python -m parsec_tpu.profiling.tools to-csv trace.json -o spans.csv
+    python -m parsec_tpu.profiling.tools check-comms trace.json \
+        --expect MPI_ACTIVATE:nb=100 --expect MPI_DATA_PLD:lensum=209715200
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List
+
+
+def load(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):  # bare event array is also legal Chrome JSON
+        doc = {"traceEvents": doc, "metadata": {}}
+    return doc
+
+
+def _spans(events: List[dict]) -> List[dict]:
+    open_spans: Dict[tuple, dict] = {}
+    rows = []
+    for e in sorted(events, key=lambda e: e.get("ts", 0)):
+        key = (e.get("pid"), e.get("tid"), e.get("name"))
+        ph = e.get("ph")
+        if ph == "B":
+            open_spans[key] = e
+        elif ph == "E" and key in open_spans:
+            b = open_spans.pop(key)
+            rows.append({"name": e["name"], "pid": e["pid"], "tid": e["tid"],
+                         "begin_us": b["ts"], "end_us": e["ts"],
+                         "dur_us": e["ts"] - b["ts"],
+                         "args": b.get("args", {})})
+        elif ph == "i":
+            rows.append({"name": e["name"], "pid": e.get("pid"),
+                         "tid": e.get("tid"), "begin_us": e["ts"],
+                         "end_us": e["ts"], "dur_us": 0.0,
+                         "args": e.get("args", {})})
+    return rows
+
+
+def cmd_info(args) -> int:
+    doc = load(args.trace)
+    evs = doc.get("traceEvents", [])
+    spans = _spans(evs)
+    pids = sorted({e.get("pid") for e in evs})
+    tids = sorted({str(e.get("tid")) for e in evs})
+    print(f"trace: {args.trace}")
+    print(f"ranks (pids): {len(pids)} {pids}")
+    print(f"streams (tids): {len(tids)}")
+    dictionary = doc.get("metadata", {}).get("dictionary", {})
+    if dictionary:
+        print(f"dictionary: {', '.join(sorted(dictionary))}")
+    per: Dict[str, List[float]] = defaultdict(list)
+    for s in spans:
+        per[s["name"]].append(s["dur_us"])
+    print(f"{'event class':<24}{'count':>8}{'total_ms':>12}{'avg_us':>10}")
+    for name in sorted(per):
+        durs = per[name]
+        total = sum(durs)
+        print(f"{name:<24}{len(durs):>8}{total/1e3:>12.3f}"
+              f"{total/len(durs):>10.1f}")
+    return 0
+
+
+def cmd_to_csv(args) -> int:
+    import csv
+
+    doc = load(args.trace)
+    spans = _spans(doc.get("traceEvents", []))
+    arg_keys = sorted({k for s in spans for k in s["args"]})
+    cols = ["name", "pid", "tid", "begin_us", "end_us", "dur_us"] + arg_keys
+    out = open(args.out, "w", newline="") if args.out else sys.stdout
+    try:
+        w = csv.writer(out)
+        w.writerow(cols)
+        for s in spans:
+            w.writerow([s[c] for c in cols[:6]] +
+                       [s["args"].get(k, "") for k in arg_keys])
+    finally:
+        if args.out:
+            out.close()
+    if args.out:
+        print(f"{len(spans)} spans -> {args.out}")
+    return 0
+
+
+def cmd_check_comms(args) -> int:
+    """Exact-count validator (reference check-comms.py asserts e.g.
+    MPI_ACTIVATE nb=100 lensum=12000 for the bandwidth test)."""
+    doc = load(args.trace)
+    spans = _spans(doc.get("traceEvents", []))
+    stats: Dict[str, Dict[str, float]] = defaultdict(lambda: {"nb": 0, "lensum": 0})
+    for s in spans:
+        st = stats[s["name"]]
+        st["nb"] += 1
+        st["lensum"] += float(s["args"].get("msg_size", s["args"].get("bytes", 0)) or 0)
+    failures = []
+    for exp in args.expect or []:
+        name, _, kv = exp.partition(":")
+        key, _, val = kv.partition("=")
+        got = stats[name][key]
+        if got != float(val):
+            failures.append(f"{name}: expected {key}={val}, got {got:g}")
+    for name in sorted(stats):
+        st = stats[name]
+        print(f"{name}: nb={int(st['nb'])} lensum={int(st['lensum'])}")
+    if failures:
+        print("FAIL: " + "; ".join(failures), file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="parsec_tpu.profiling.tools",
+        description="offline trace tools (dbpinfos/dbp2xml/check-comms "
+        "analogues)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pi = sub.add_parser("info", help="trace summary (dbpinfos analogue)")
+    pi.add_argument("trace")
+    pi.set_defaults(fn=cmd_info)
+    pc = sub.add_parser("to-csv", help="flatten spans to CSV")
+    pc.add_argument("trace")
+    pc.add_argument("-o", "--out")
+    pc.set_defaults(fn=cmd_to_csv)
+    pk = sub.add_parser("check-comms", help="comm protocol validator")
+    pk.add_argument("trace")
+    pk.add_argument("--expect", action="append",
+                    help="NAME:nb=N or NAME:lensum=BYTES (repeatable)")
+    pk.set_defaults(fn=cmd_check_comms)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

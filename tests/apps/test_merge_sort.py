@@ -1,0 +1,66 @@
+"""Parallel merge sort app (reference tests/apps/merge_sort): SORT leaves
++ binary MERGE reduction tree, here as a JDF program."""
+
+import os
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl import compile_jdf_file
+
+JDF = os.path.join(os.path.dirname(__file__), "..", "..",
+                   "examples", "jdf", "merge_sort.jdf")
+
+
+def _setup(nt, chunk, seed=0, nodes=1, myrank=0):
+    rng = np.random.default_rng(seed)
+    chunks = {i: rng.integers(0, 1000, chunk).astype(np.int64)
+              for i in range(nt)}
+    dataA = LocalCollection("dataA", shape=(chunk,), nodes=nodes,
+                            myrank=myrank, init=lambda k: chunks[k].copy())
+    result = LocalCollection("result", shape=(nt * chunk,), nodes=nodes,
+                             myrank=myrank,
+                             init=lambda k: np.zeros(nt * chunk, np.int64))
+    expected = np.sort(np.concatenate([chunks[i] for i in range(nt)]))
+    return dataA, result, expected
+
+
+def test_merge_sort_single_rank():
+    NT, CHUNK = 8, 16
+    jdf = compile_jdf_file(JDF)
+    dataA, result, expected = _setup(NT, CHUNK)
+    ctx = Context(nb_cores=4)
+    try:
+        tp = jdf.new(dataA=dataA, result=result, NT=NT, H=3)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=60)
+    finally:
+        ctx.fini()
+    got = result.data_of(0).newest_copy().payload
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_merge_sort_multirank():
+    """Leaves spread over 2 ranks by dataA affinity; merge tree pulls
+    remote runs through the comm engine; root writes on the owner of
+    result(0)."""
+    from tests.runtime.test_multirank import run_ranks
+
+    NT, CHUNK, NR = 8, 8, 2
+    jdf = compile_jdf_file(JDF)
+    results = {}
+    expected_holder = {}
+
+    def build(rank, ctx):
+        dataA, result, expected = _setup(NT, CHUNK, nodes=NR, myrank=rank)
+        dataA.rank_of = lambda *key: (key[0] if key else 0) % NR
+        result.rank_of = lambda *key: 0
+        results[rank] = result
+        expected_holder[rank] = expected
+        return jdf.new(dataA=dataA, result=result, NT=NT, H=3)
+
+    run_ranks(NR, build)
+    got = results[0].data_of(0).newest_copy().payload
+    np.testing.assert_array_equal(got, expected_holder[0])

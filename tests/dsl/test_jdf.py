@@ -433,6 +433,39 @@ END
         np.testing.assert_allclose(dc.data_of(k).newest_copy().payload, 2.0 * k)
 
 
+def test_single_line_prologue_and_chained_defaults(ctx):
+    """A one-line `%{ ... %}` block parses, and a global default may
+    reference an earlier global's default."""
+    src = """
+%{ BASE = 3 %}
+
+D [ type = "collection" ]
+M [ type = int default = %{ BASE + 1 %} ]
+N [ type = int default = %{ M * 2 %} ]
+
+t(k)
+
+k = 0 .. N-1
+
+: D( k )
+
+RW X <- D( k )
+     -> D( k )
+
+BODY
+{
+    X[:] = 1.0
+}
+END
+"""
+    jdf = compile_jdf(src, "defaults")
+    assert jdf.ptg.constants["M"] == 4 and jdf.ptg.constants["N"] == 8
+    dc = LocalCollection("D", shape=(1,), init=lambda k: np.zeros(1))
+    tp = jdf.new(D=dc)
+    _run(ctx, tp)
+    assert dc.data_of(7).newest_copy().payload[0] == 1.0
+
+
 # ---------------------------------------------------------------------------
 # error reporting
 # ---------------------------------------------------------------------------
@@ -498,6 +531,64 @@ def test_jdfc_cli(tmp_path, capsys):
     assert "def new(" in out_path.read_text()
     assert jdfc_main(["--check", str(jdf_path)]) == 0
     assert "OK" in capsys.readouterr().out
+
+
+def test_jdfc_preserves_properties_and_priority(tmp_path):
+    """Generated modules keep task properties and the high_priority
+    boost (parity with compile_jdf), and chained global defaults."""
+    src = """
+%{ BASE = 2 %}
+D [ type = "collection" ]
+M [ type = int default = %{ BASE + 2 %} ]
+N [ type = int default = M ]
+
+t(k) [ high_priority = on ]
+
+k = 0 .. N-1
+
+: D( k )
+
+RW X <- D( k )
+     -> D( k )
+
+BODY
+{
+    pass
+}
+END
+"""
+    out = tmp_path / "hp_ptg.py"
+    out.write_text(generate(src, "hp", source="hp.jdf"))
+    mod = _import_generated(str(out), "hp_ptg_generated")
+    ptg = mod.build()
+    pc = ptg.classes["t"]
+    assert pc.properties.get("high_priority") == "on"
+    assert pc.priority_of((0,), {}) == 1 << 20
+    assert ptg.constants["M"] == 4 and ptg.constants["N"] == 4
+
+
+def test_template_device_writeback_after_tpu(tmp_path):
+    """Template device writes land on host copy 0 even when the newest
+    copy lives on the TPU device (regression: version_bump on a stale
+    copy dropped the output)."""
+    from parsec_tpu import Context, DEV_TPU
+    from parsec_tpu.data import data_create
+    from parsec_tpu.device.template import DEV_TEMPLATE
+    from parsec_tpu.dsl import DTDTaskpool, INOUT as DTD_INOUT
+    from parsec_tpu.dsl.dtd import stage_to_cpu
+
+    ctx2 = Context(nb_cores=2, devices=["tpu", "template"])
+    try:
+        d = data_create("x", payload=np.full(4, 1.0))
+        tp = DTDTaskpool(ctx2)
+        # first a TPU task (newest copy moves to the device)...
+        tp.insert_task({DEV_TPU: lambda x: x + 1.0}, (d, DTD_INOUT))
+        # ...then a template task must read 2.0 and publish 6.0 on host
+        tp.insert_task({DEV_TEMPLATE: lambda x: x * 3.0}, (d, DTD_INOUT))
+        assert tp.wait(timeout=60)
+        np.testing.assert_allclose(stage_to_cpu(d), 6.0)
+    finally:
+        ctx2.fini()
 
 
 def test_jdfc_stencil_roundtrip(tmp_path):

@@ -1,0 +1,99 @@
+"""PAPI-SDE counter registry + alperf PINS module tests (reference
+papi_sde.c counter set; mca/pins/alperf)."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl.ptg import PTG, INOUT
+from parsec_tpu.profiling import AlperfModule, SDEModule, dictionary, sde
+
+
+@pytest.fixture
+def clean_sde():
+    sde.reset()
+    yield
+    sde.reset()
+
+
+def _chain_tp(n):
+    dc = LocalCollection("D", shape=(1,), init=lambda k: np.zeros(1))
+    ptg = PTG("chain")
+    step = ptg.task_class("step", k=f"0 .. N-1")
+    step.affinity("D(0)")
+    step.flow("X", INOUT,
+              "<- (k == 0) ? D(0) : X step(k-1)",
+              "-> (k < N-1) ? X step(k+1) : D(0)")
+    step.body(cpu=lambda X, k: X.__iadd__(1.0))
+    return ptg.taskpool(N=n, D=dc), dc
+
+
+def test_counter_registry(clean_sde):
+    sde.counter_add("MY::COUNTER", 5)
+    sde.counter_add("MY::COUNTER", 2.5)
+    assert sde.read("MY::COUNTER") == 7.5
+    sde.counter_set("MY::COUNTER", 1)
+    assert sde.read("MY::COUNTER") == 1
+    assert "MY::COUNTER" in sde.list_counters()
+    assert sde.read("UNKNOWN") == 0
+
+
+def test_sde_module_standard_counters(clean_sde):
+    N = 12
+    mod = SDEModule()
+    try:
+        ctx = Context(nb_cores=2)
+        try:
+            tp, _ = _chain_tp(N)
+            ctx.add_taskpool(tp)
+            assert tp.wait(timeout=30)
+        finally:
+            ctx.fini()
+        assert sde.read(sde.TASKS_ENABLED) == N
+        assert sde.read(sde.TASKS_RETIRED) == N
+        assert sde.read(sde.PENDING_TASKS) == 0  # queue drained
+        # published into the live-properties dictionary
+        snap = dictionary.snapshot()
+        assert snap[f"sde.{sde.TASKS_RETIRED}"] == N
+    finally:
+        mod.disable()
+
+
+def test_alperf_per_class_counts_and_measures(clean_sde):
+    N = 8
+    mod = AlperfModule()
+    # a flops-model measure: constant per task
+    mod.declare_measure("flops", lambda task: 100.0)
+    try:
+        ctx = Context(nb_cores=2)
+        try:
+            tp, _ = _chain_tp(N)
+            ctx.add_taskpool(tp)
+            assert tp.wait(timeout=30)
+        finally:
+            ctx.fini()
+        r = mod.report()
+        assert r["tasks_total"] == N
+        assert r["per_class"]["step"]["tasks"] == N
+        assert r["per_class"]["step"]["time_s"] >= 0
+        assert r["per_class"]["step"]["flops"] == 100.0 * N
+        assert r["tasks_per_s"] > 0
+        assert dictionary.snapshot()["alperf"]["tasks_total"] == N
+    finally:
+        mod.disable()
+    assert "alperf" not in dictionary.snapshot()
+
+
+def test_disabled_modules_cost_nothing(clean_sde):
+    """After disable(), running a taskpool leaves the counters untouched."""
+    mod = SDEModule()
+    mod.disable()
+    ctx = Context(nb_cores=2)
+    try:
+        tp, _ = _chain_tp(5)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=30)
+    finally:
+        ctx.fini()
+    assert sde.read(sde.TASKS_RETIRED) == 0

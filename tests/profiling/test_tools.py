@@ -1,0 +1,77 @@
+"""Offline trace tools CLI (reference tools/profiling: dbpinfos,
+profile2h5, check-comms.py)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl.ptg import PTG, INOUT
+from parsec_tpu.profiling import TaskProfiler, Trace
+from parsec_tpu.profiling.tools import main as tools_main
+
+
+@pytest.fixture
+def trace_file(tmp_path):
+    """Run a small chain with the task profiler and dump a trace."""
+    prof = TaskProfiler().install()
+    try:
+        dc = LocalCollection("D", shape=(1,), init=lambda k: np.zeros(1))
+        ptg = PTG("chain")
+        step = ptg.task_class("step", k="0 .. N-1")
+        step.affinity("D(0)")
+        step.flow("X", INOUT,
+                  "<- (k == 0) ? D(0) : X step(k-1)",
+                  "-> (k < N-1) ? X step(k+1) : D(0)")
+        step.body(cpu=lambda X, k: X.__iadd__(1.0))
+        ctx = Context(nb_cores=2)
+        try:
+            tp = ptg.taskpool(N=10, D=dc)
+            ctx.add_taskpool(tp)
+            assert tp.wait(timeout=30)
+        finally:
+            ctx.fini()
+        path = tmp_path / "trace.json"
+        prof.trace.dump(str(path))
+    finally:
+        prof.uninstall()
+    return path
+
+
+def test_info(trace_file, capsys):
+    assert tools_main(["info", str(trace_file)]) == 0
+    out = capsys.readouterr().out
+    assert "event class" in out
+    assert "exec" in out
+    assert "10" in out  # 10 exec spans
+
+
+def test_to_csv(trace_file, tmp_path, capsys):
+    out_csv = tmp_path / "spans.csv"
+    assert tools_main(["to-csv", str(trace_file), "-o", str(out_csv)]) == 0
+    lines = out_csv.read_text().strip().split("\n")
+    assert lines[0].startswith("name,pid,tid,begin_us,end_us,dur_us")
+    assert sum(1 for ln in lines[1:] if ln.startswith("exec,")) == 10
+
+
+def test_check_comms_pass_and_fail(tmp_path, capsys):
+    """Synthetic comm trace with exact counts (reference check-comms.py
+    pins MPI_ACTIVATE nb / lensum)."""
+    evs = []
+    for i in range(4):
+        evs.append({"name": "MPI_ACTIVATE", "ph": "i", "ts": float(i),
+                    "pid": 0, "tid": "comm", "args": {"msg_size": 120}})
+    for i in range(2):
+        evs.append({"name": "MPI_DATA_PLD", "ph": "i", "ts": 10.0 + i,
+                    "pid": 0, "tid": "comm", "args": {"msg_size": 1 << 20}})
+    path = tmp_path / "comm.json"
+    path.write_text(json.dumps({"traceEvents": evs}))
+    assert tools_main(["check-comms", str(path),
+                       "--expect", "MPI_ACTIVATE:nb=4",
+                       "--expect", "MPI_ACTIVATE:lensum=480",
+                       "--expect", "MPI_DATA_PLD:lensum=2097152"]) == 0
+    assert tools_main(["check-comms", str(path),
+                       "--expect", "MPI_ACTIVATE:nb=5"]) == 1
+    assert "FAIL" in capsys.readouterr().err

@@ -1,0 +1,32 @@
+"""Template device module (reference mca/device/template): inert by
+default, attachable by explicit selection, executes chores synchronously."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.data import data_create
+from parsec_tpu.device.template import DEV_TEMPLATE, TemplateDevice
+from parsec_tpu.dsl import DTDTaskpool, INOUT
+
+
+def test_inert_by_default():
+    ctx = Context(nb_cores=2)
+    try:
+        assert not any(isinstance(d, TemplateDevice) for d in ctx.devices)
+    finally:
+        ctx.fini()
+
+
+def test_explicit_selection_attaches_and_executes():
+    ctx = Context(nb_cores=2, devices=["tpu", "template"])
+    try:
+        tdev = next(d for d in ctx.devices if isinstance(d, TemplateDevice))
+        d = data_create("x", payload=np.full(4, 2.0))
+        tp = DTDTaskpool(ctx)
+        tp.insert_task({DEV_TEMPLATE: lambda x: x * 3.0}, (d, INOUT))
+        assert tp.wait(timeout=30)
+        np.testing.assert_allclose(d.newest_copy().payload, 6.0)
+        assert tdev.stats["executed_tasks"] == 1
+    finally:
+        ctx.fini()
