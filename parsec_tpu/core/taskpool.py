@@ -64,6 +64,14 @@ class Taskpool:
         self.task_classes[tc.task_class_id] = tc
         return tc
 
+    def addto_nb_tasks(self, delta: int) -> None:
+        """Adjust the expected task count at run time (reference
+        ``tdm.module->taskpool_addto_nb_tasks``).  Dynamically-routed DAGs
+        use this from a body to discount tasks that will never execute —
+        the reference choice.jdf decrements for the not-taken branch
+        sibling (``tests/dsl/ptg/choice/choice.jdf:67,86``)."""
+        self.tdm.taskpool_addto_nb_tasks(self, delta)
+
     # -- lifecycle --------------------------------------------------------
     def attached(self, context: "Context") -> None:
         """Called by ``Context.add_taskpool``."""

@@ -116,16 +116,21 @@ class TemplateDevice(Device):
         # the CPU hook), never newest_copy() which may be a device copy
         from ..core.lifecycle import AccessMode
 
-        outs = None
+        writable = [spec[1] for spec in task.body_args or ()
+                    if spec[0] == "data" and spec[1] is not None
+                    and spec[2] & AccessMode.OUT]
         if result is not None:
-            outs = iter(result if isinstance(result, (tuple, list)) else (result,))
-        for spec in task.body_args or ():
-            if spec[0] == "data" and spec[1] is not None and spec[2] & AccessMode.OUT:
-                if outs is not None:
-                    import numpy as np
+            outs = result if isinstance(result, (tuple, list)) else (result,)
+            if len(outs) != len(writable):
+                raise ValueError(
+                    f"{task!r}: body returned {len(outs)} outputs for "
+                    f"{len(writable)} writable flows")
+            import numpy as np
 
-                    spec[1].get_copy(0).payload = np.asarray(next(outs))
-                spec[1].version_bump(0)
+            for data, new in zip(writable, outs):
+                data.get_copy(0).payload = np.asarray(new)
+        for data in writable:
+            data.version_bump(0)
         # executed_tasks is accounted centrally at completion
         # (core/scheduling.py), like every other device
         return HookReturn.DONE

@@ -93,6 +93,15 @@ class JDFGlobal:
     def has_default(self) -> bool:
         return "default" in self.props
 
+    @property
+    def is_collection(self) -> bool:
+        """Collections are not passed into bodies (only scalar globals
+        are — reference bodies see them as C globals); detected from the
+        declared type (reference JDFs say "parsec_data_collection_t*",
+        "parsec_tiled_matrix_t*"; ours say "collection")."""
+        t = self.props.get("type", "").strip().strip('"').lower()
+        return "collection" in t or "matrix" in t or t.endswith("*")
+
 
 @dataclass
 class JDFBody:
@@ -475,11 +484,38 @@ class _Parser:
 # lowering to the PTG builder (the jdf2c analogue)
 # ---------------------------------------------------------------------------
 
+def uses_this_task(code: str) -> bool:
+    """True when the body code references the ``this_task`` identifier
+    (real NAME tokens only — not comments or string literals)."""
+    import io
+    import tokenize
+
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(code).readline):
+            if tok.type == tokenize.NAME and tok.string == "this_task":
+                return True
+        return False
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # un-tokenizable snippet: fall back to a plain word search
+        return bool(re.search(r"\bthis_task\b", code))
+
+
 def _compile_body(body: JDFBody, tc: JDFTaskClass, namespace: Dict[str, Any],
-                  jdf_name: str) -> Callable:
-    """A BODY block → Python function over (flows, params, definitions)."""
+                  jdf_name: str, scalar_globals: Optional[List[str]] = None) -> Callable:
+    """A BODY block → Python function over (flows, params, definitions,
+    scalar globals) — reference bodies see JDF globals as C globals."""
     args = [f.name for f in tc.flows if _MODES[f.mode] != CTL]
     args += [n for n, _ in tc.decls]
+    args += [n for n in (scalar_globals or []) if n not in args]
+    if uses_this_task(body.code):
+        # reference bodies use `this_task` (e.g. choice.jdf decrements
+        # nb_tasks for the not-taken branch); CPU incarnations only —
+        # a Task object cannot be traced through jax.jit
+        if body.device != "cpu":
+            raise ValueError(
+                f"task {tc.name}: this_task is only available in CPU "
+                "BODY incarnations")
+        args.append("this_task")
     fname = f"_jdf_{tc.name}_{body.device}_body"
     src = f"def {fname}({', '.join(args)}):\n" + textwrap.indent(body.code or "pass", "    ")
     code = compile(src, f"<jdf:{jdf_name}:{tc.name}:BODY@{body.line}>", "exec")
@@ -517,10 +553,12 @@ class JDF:
                 except Exception as e:
                     raise ValueError(
                         f"global {g.name}: bad default {g.props['default']!r}: {e}")
+        scalar_globals = [g.name for g in self.ast.globals if not g.is_collection]
         for tc in self.ast.classes:
             pc = ptg.task_class(tc.name)
             pc.properties.update(tc.props)
             params = set(tc.params)
+            local_names = {n for n, _ in tc.decls}
             for name, expr in tc.decls:
                 if name in params:
                     pc.param(name, expr)
@@ -530,6 +568,12 @@ class JDF:
                 pc.affinity(tc.partitioning)
             for f in tc.flows:
                 pc.flow(f.name, _MODES[f.mode], *f.deps)
+            # scalar globals shadowed by a local or a flow keep the
+            # local/flow binding in bodies (C scoping: inner wins)
+            flow_names = {f.name for f in tc.flows}
+            body_globals = [n for n in scalar_globals
+                            if n not in local_names and n not in flow_names]
+            pc.use_globals(*body_globals)
             if tc.priority:
                 pc.priority(tc.priority)
             elif tc.props.get("high_priority", "").lower() in ("on", "yes", "true", "1"):
@@ -543,7 +587,8 @@ class JDF:
                 if dev in bodies:
                     raise ValueError(
                         f"task {tc.name}: duplicate BODY for device {dev!r}")
-                bodies[dev] = _compile_body(b, tc, self.namespace, self.ast.name)
+                bodies[dev] = _compile_body(
+                    b, tc, self.namespace, self.ast.name, body_globals)
             pc.body(**bodies)
         return ptg
 

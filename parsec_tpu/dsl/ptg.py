@@ -332,6 +332,9 @@ class PTGTaskClass:
         self._priority: Optional[_Expr] = None
         self.bodies: Dict[str, Callable] = {}
         self.properties: Dict[str, Any] = {}
+        #: taskpool-constant names passed to bodies by name (JDF globals
+        #: are visible inside reference BODY blocks as C globals)
+        self.body_globals: List[str] = []
 
     @property
     def param_names(self) -> List[str]:
@@ -344,6 +347,11 @@ class PTGTaskClass:
     def define(self, name: str, expr: str) -> "PTGTaskClass":
         """Append a derived-local definition (JDF ``name = expr`` line)."""
         self.decls.append((name, _ArgExpr(expr), False))
+        return self
+
+    def use_globals(self, *names: str) -> "PTGTaskClass":
+        """Declare taskpool constants the bodies receive as keyword args."""
+        self.body_globals.extend(n for n in names if n not in self.body_globals)
         return self
 
     def param(self, name: str, range_src: str) -> "PTGTaskClass":
@@ -437,6 +445,16 @@ class PTGTaskClass:
                 return dep, t
         return None
 
+    def input_defined(self, f: _PTGFlow, env: Dict[str, Any]) -> bool:
+        """True when some input dep *matches* under env — including an
+        explicit NONE branch ("this flow has no input here", defined).
+        False means no guard matched at all: with dynamic guards
+        (choice.jdf) the route simply isn't decided yet."""
+        for dep in f.deps_in:
+            if dep.target(env) is not None:
+                return True
+        return False
+
     def goal_of(self, locals_: Tuple, constants: Dict[str, Any]) -> int:
         """Counter-mode dependency goal. Data flows have exactly one active
         source (guarded alternatives, JDF single-assignment); CTL flows
@@ -527,6 +545,12 @@ class PTGTaskpool(Taskpool):
 
     # -- vtable construction (the jdf2c analogue) ------------------------
     def _build_class(self, pc: PTGTaskClass) -> None:
+        taken = {f.name for f in pc.flows} | {n for n, _, _ in pc.decls}
+        clash = [n for n in pc.body_globals if n in taken]
+        if clash:
+            raise ValueError(
+                f"class {pc.name}: use_globals names {clash} collide with "
+                "a flow or local — bodies would receive the wrong value")
         flows = [Flow(f.name, f.mode, f.index) for f in pc.flows]
         tc = TaskClass(pc.name, flows=flows, nb_parameters=len(pc.param_names))
         tc.prepare_input = self._make_prepare_input(pc)
@@ -554,12 +578,44 @@ class PTGTaskpool(Taskpool):
         return cached
 
     def _startup(self, context, tp) -> List[Task]:
+        from ..utils import debug
+
         out = []
         for pc in self.ptg.classes.values():
+            undefined = 0
             for loc in self._local_space(pc):
-                if pc.goal_of(loc, self.constants) == 0:
+                if self._is_startup(pc, loc):
                     out.append(self._make_task(pc, loc))
+                elif pc.goal_of(loc, self.constants) == 0:
+                    undefined += 1
+            if undefined:
+                # goal 0 but some readable flow had no matched input dep:
+                # legitimate with dynamic guards (a producer releases the
+                # task later), a guaranteed hang if the guards are static
+                debug.verbose(
+                    2, "ptg",
+                    "%s: %d task(s) held back from startup — a readable "
+                    "flow matched no input dep; if its guards are static, "
+                    "add an explicit '<- NONE' fallback", pc.name, undefined)
         return out
+
+    def _is_startup(self, pc: PTGTaskClass, loc: Tuple) -> bool:
+        """A task starts immediately only when its dependency goal is zero
+        AND every readable flow that declares input deps has a guard-true
+        one right now.  With *dynamic* guards (reference choice.jdf: guards
+        read state written by other tasks' bodies) all guards of a flow can
+        be false at enqueue time — such a task is NOT a source; its
+        producer releases it later, re-evaluating the goal then.  Treating
+        it as startup would execute it twice (startup + release)."""
+        if pc.goal_of(loc, self.constants) != 0:
+            return False
+        env = pc.env_of(loc, self.constants)
+        for f in pc.flows:
+            if f.mode == CTL or not (f.mode & AccessMode.IN):
+                continue
+            if f.deps_in and not pc.input_defined(f, env):
+                return False
+        return True
 
     def _make_task(self, pc: PTGTaskClass, locals_: Tuple) -> Task:
         return Task(self, self._built[pc.name], locals_,
@@ -593,7 +649,7 @@ class PTGTaskpool(Taskpool):
                         data = materialize(get_copy_reshape(data, rspec))
                 specs.append(("data", data, f.mode))
                 task.data_in[f.index] = data.newest_copy() if data is not None else None
-            for name in pc.param_names + pc.def_names:
+            for name in pc.param_names + pc.def_names + pc.body_globals:
                 specs.append(("value", env[name], AccessMode.VALUE))
             task.body_args = specs
             return HookReturn.DONE
@@ -770,7 +826,8 @@ def _accel_hook(es, task):
 def _wrap_device_body(pc: PTGTaskClass, fn: Callable):
     """The device module passes positional args (non-CTL flows, then
     params); re-map to the uniform keyword signature body(FLOW=..., k=...)."""
-    names = [f.name for f in pc.flows if f.mode != CTL] + pc.param_names + pc.def_names
+    names = ([f.name for f in pc.flows if f.mode != CTL]
+             + pc.param_names + pc.def_names + pc.body_globals)
 
     def wrapped(*pos):
         return fn(**dict(zip(names, pos)))
@@ -784,6 +841,15 @@ def _wrap_device_body(pc: PTGTaskClass, fn: Callable):
 
 
 def _make_cpu_hook(pc: PTGTaskClass, fn: Callable):
+    # reference BODY blocks see `this_task` implicitly; here it is opt-in
+    # by naming it in the body signature (CPU incarnations only)
+    try:
+        import inspect
+
+        wants_this_task = "this_task" in inspect.signature(fn).parameters
+    except (TypeError, ValueError):  # builtins / C callables
+        wants_this_task = False
+
     def cpu_hook(es, task: Task) -> HookReturn:
         from .dtd import stage_to_cpu
 
@@ -802,7 +868,9 @@ def _make_cpu_hook(pc: PTGTaskClass, fn: Callable):
             if f.mode & AccessMode.OUT:
                 writable.append(data)
         values = [s[1] for s in task.body_args if s[0] == "value"]
-        kw.update(zip(pc.param_names + pc.def_names, values))
+        kw.update(zip(pc.param_names + pc.def_names + pc.body_globals, values))
+        if wants_this_task:
+            kw["this_task"] = task
         result = fn(**kw)
         if result is not None:
             outs = result if isinstance(result, (tuple, list)) else (result,)

@@ -83,7 +83,7 @@ def replay_via_dtd(
             args.append((tile_for(source_tile(g, tid, f.name)), f.mode))
             kw_order.append(f.name)
         env = pc.env_of(locs, consts)
-        for pname in pc.param_names + pc.def_names:
+        for pname in pc.param_names + pc.def_names + pc.body_globals:
             args.append((env[pname], VALUE))
             kw_order.append(pname)
         # control edges: consume producers' dummy tiles, publish my own

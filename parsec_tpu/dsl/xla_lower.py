@@ -103,7 +103,8 @@ class GraphExecutor:
                         seen_in.add(hk)
                         homes_in.append(hk)
             penv = pc.env_of(tid[1], tp.constants)
-            params = {n: penv[n] for n in pc.param_names + pc.def_names}
+            params = {n: penv[n]
+                      for n in pc.param_names + pc.def_names + pc.body_globals}
             wbs = [(fn_, cn, tuple(k)) for (fn_, cn, k) in node.write_backs]
             for (_fn, cn, k) in wbs:
                 hk = (cn, k)

@@ -141,18 +141,27 @@ def stencil_ptg(*, use_tpu: bool = False) -> PTG:
     # previous generation: own tile + four halos (guarded at boundaries)
     st.flow("OLD", IN,
             "<- (t == 0) ? A(0, i, j) : NEW stencil(t-1, i, j)")
+    # halo flows end in an explicit `<- NONE` fallback: a flow with *no*
+    # matched input dep is "route not decided yet" (dynamic guards,
+    # reference jdf2c.c:3008 startup rules), while the boundary tiles here
+    # statically have no neighbor — which must be said explicitly (the
+    # reference stencil writes `(...)? A task(...): NULL` the same way)
     st.flow("UP", IN,
             "<- (t == 0 and i > 0) ? A(0, i-1, j)",
-            "<- (t > 0 and i > 0) ? NEW stencil(t-1, i-1, j)")
+            "<- (t > 0 and i > 0) ? NEW stencil(t-1, i-1, j)",
+            "<- NONE")
     st.flow("DOWN", IN,
             "<- (t == 0 and i < MT-1) ? A(0, i+1, j)",
-            "<- (t > 0 and i < MT-1) ? NEW stencil(t-1, i+1, j)")
+            "<- (t > 0 and i < MT-1) ? NEW stencil(t-1, i+1, j)",
+            "<- NONE")
     st.flow("LEFT", IN,
             "<- (t == 0 and j > 0) ? A(0, i, j-1)",
-            "<- (t > 0 and j > 0) ? NEW stencil(t-1, i, j-1)")
+            "<- (t > 0 and j > 0) ? NEW stencil(t-1, i, j-1)",
+            "<- NONE")
     st.flow("RIGHT", IN,
             "<- (t == 0 and j < NT-1) ? A(0, i, j+1)",
-            "<- (t > 0 and j < NT-1) ? NEW stencil(t-1, i, j+1)")
+            "<- (t > 0 and j < NT-1) ? NEW stencil(t-1, i, j+1)",
+            "<- NONE")
     # the write buffer: the opposite-parity tile, WAR-safe (see module doc)
     st.flow("NEW", INOUT,
             "<- A((t+1) % 2, i, j)",

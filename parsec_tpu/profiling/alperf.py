@@ -30,9 +30,13 @@ class AlperfModule:
         self._begin: Dict[int, float] = {}  # id(task) -> ts
         self._per_class: Dict[str, Dict[str, float]] = {}
         self._measures: Dict[str, Callable[[Any], float]] = {}
+        # account at COMPLETE_EXEC_END, not EXEC_END: for async device
+        # chores EXEC_END fires when the hook merely *enqueued* the task
+        # (HookReturn.ASYNC), while complete_execution runs once the work
+        # actually retired — on every path, sync or async
         self._subs = [
             (pins.EXEC_BEGIN, self._on_begin),
-            (pins.EXEC_END, self._on_end),
+            (pins.COMPLETE_EXEC_END, self._on_end),
         ]
         for site, cb in self._subs:
             pins.subscribe(site, cb)

@@ -109,3 +109,7 @@ class SDEModule:
     def disable(self) -> None:
         for site, cb in self._subs:
             pins.unsubscribe(site, cb)
+        # symmetric teardown: stale frozen values must not keep being
+        # served as live properties
+        for name in (TASKS_ENABLED, TASKS_RETIRED, PENDING_TASKS):
+            unregister_counter(name)

@@ -39,32 +39,16 @@ def load(path: str) -> Dict[str, Any]:
 
 
 def _spans(events: List[dict]) -> List[dict]:
-    open_spans: Dict[tuple, dict] = {}
-    rows = []
-    for e in sorted(events, key=lambda e: e.get("ts", 0)):
-        key = (e.get("pid"), e.get("tid"), e.get("name"))
-        ph = e.get("ph")
-        if ph == "B":
-            open_spans[key] = e
-        elif ph == "E" and key in open_spans:
-            b = open_spans.pop(key)
-            rows.append({"name": e["name"], "pid": e["pid"], "tid": e["tid"],
-                         "begin_us": b["ts"], "end_us": e["ts"],
-                         "dur_us": e["ts"] - b["ts"],
-                         "args": b.get("args", {})})
-        elif ph == "i":
-            rows.append({"name": e["name"], "pid": e.get("pid"),
-                         "tid": e.get("tid"), "begin_us": e["ts"],
-                         "end_us": e["ts"], "dur_us": 0.0,
-                         "args": e.get("args", {})})
-    return rows
+    from .trace import iter_spans
+
+    return iter_spans(events)
 
 
 def cmd_info(args) -> int:
     doc = load(args.trace)
     evs = doc.get("traceEvents", [])
     spans = _spans(evs)
-    pids = sorted({e.get("pid") for e in evs})
+    pids = sorted({e.get("pid") for e in evs}, key=str)
     tids = sorted({str(e.get("tid")) for e in evs})
     print(f"trace: {args.trace}")
     print(f"ranks (pids): {len(pids)} {pids}")
@@ -120,8 +104,18 @@ def cmd_check_comms(args) -> int:
     for exp in args.expect or []:
         name, _, kv = exp.partition(":")
         key, _, val = kv.partition("=")
+        if key not in ("nb", "lensum") or not val:
+            print(f"bad --expect {exp!r}: want NAME:nb=N or NAME:lensum=BYTES",
+                  file=sys.stderr)
+            return 2
+        try:
+            want = float(val)
+        except ValueError:
+            print(f"bad --expect {exp!r}: {val!r} is not a number",
+                  file=sys.stderr)
+            return 2
         got = stats[name][key]
-        if got != float(val):
+        if got != want:
             failures.append(f"{name}: expected {key}={val}, got {got:g}")
     for name in sorted(stats):
         st = stats[name]

@@ -98,27 +98,37 @@ class Trace:
         """Pandas frame of complete spans (reference pbt2ptt → pandas)."""
         import pandas as pd
 
-        evs = self.events()
-        open_spans: Dict[tuple, dict] = {}
-        rows = []
-        for e in evs:
-            key = (e["pid"], e["tid"], e["name"])
-            if e["ph"] == "B":
-                open_spans[key] = e
-            elif e["ph"] == "E" and key in open_spans:
-                b = open_spans.pop(key)
-                rows.append({
-                    "name": e["name"], "pid": e["pid"], "tid": e["tid"],
-                    "begin_us": b["ts"], "end_us": e["ts"],
-                    "dur_us": e["ts"] - b["ts"], **b.get("args", {}),
-                })
-            elif e["ph"] == "i":  # instants: zero-duration rows
-                rows.append({
-                    "name": e["name"], "pid": e["pid"], "tid": e["tid"],
-                    "begin_us": e["ts"], "end_us": e["ts"], "dur_us": 0.0,
-                    **e.get("args", {}),
-                })
-        return pd.DataFrame(rows)
+        return pd.DataFrame([
+            {"name": s["name"], "pid": s["pid"], "tid": s["tid"],
+             "begin_us": s["begin_us"], "end_us": s["end_us"],
+             "dur_us": s["dur_us"], **s["args"]}
+            for s in iter_spans(self.events())
+        ])
+
+
+def iter_spans(events: List[dict]) -> List[dict]:
+    """Pair B/E events into complete spans; instants become zero-duration
+    rows. Tolerates missing pid/tid (legal in Chrome traces). Shared by
+    :meth:`Trace.to_dataframe` and the offline tools CLI."""
+    open_spans: Dict[tuple, dict] = {}
+    rows: List[dict] = []
+    for e in sorted(events, key=lambda e: e.get("ts", 0)):
+        pid, tid, name = e.get("pid"), e.get("tid"), e.get("name")
+        key = (pid, tid, name)
+        ph = e.get("ph")
+        if ph == "B":
+            open_spans[key] = e
+        elif ph == "E" and key in open_spans:
+            b = open_spans.pop(key)
+            rows.append({"name": name, "pid": pid, "tid": tid,
+                         "begin_us": b["ts"], "end_us": e["ts"],
+                         "dur_us": e["ts"] - b["ts"],
+                         "args": b.get("args", {})})
+        elif ph == "i":
+            rows.append({"name": name, "pid": pid, "tid": tid,
+                         "begin_us": e["ts"], "end_us": e["ts"],
+                         "dur_us": 0.0, "args": e.get("args", {})})
+    return rows
 
 
 class _PinsModule:

@@ -1,0 +1,355 @@
+"""Ports of the reference PTG compiler testsuite cases
+(/root/reference/tests/dsl/ptg/: branching, choice, local-indices,
+multisize_bcast shapes) through the JDF front-end."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl import compile_jdf
+
+
+@pytest.fixture
+def ctx():
+    c = Context(nb_cores=4)
+    yield c
+    c.fini()
+
+
+class Counter:
+    def __init__(self):
+        self.v = 0
+        self._l = threading.Lock()
+
+    def inc(self):
+        with self._l:
+            self.v += 1
+
+
+def test_branching(ctx):
+    """branching.jdf: TA(k) fans out to TB(2k),TB(2k+1); TB routes to
+    TC's T1 or T2 flow by parity; counts must be NT/2NT/NT."""
+    src = """
+A  [ type = "collection" ]
+NT [ type = int ]
+
+TA(k)
+
+zero = 0
+nt = NT
+k = zero .. nt-1
+
+: A( k )
+
+RW T <- A( k )
+     -> T TB( 2*k .. 2*k+1 )
+
+BODY
+{
+    nbA.inc()
+}
+END
+
+TB(k)
+
+k = 0 .. 2*NT-1
+kh = %{ k // 2 %}
+
+: A( k % NT )
+
+RW T <- T TA( kh )
+     -> (k % 2 == 0) ? T1 TC( kh ) : T2 TC( kh )
+
+BODY
+{
+    nbB.inc()
+}
+END
+
+TC(k)
+
+k = 0 .. NT-1
+
+: A( k )
+
+RW   T1 <- T TB( 2*k )
+        -> A( k )
+READ T2 <- T TB( 2*k+1 )
+
+BODY
+{
+    nbC.inc()
+}
+END
+"""
+    NT = 6
+    nbA, nbB, nbC = Counter(), Counter(), Counter()
+    jdf = compile_jdf(src, "branching",
+                      namespace={"nbA": nbA, "nbB": nbB, "nbC": nbC})
+    dc = LocalCollection("A", shape=(1,), init=lambda k: np.zeros(1))
+    tp = jdf.new(A=dc, NT=NT)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=60)
+    assert (nbA.v, nbB.v, nbC.v) == (NT, 2 * NT, NT)
+
+
+def test_choice_dynamic_guards(ctx):
+    """choice.jdf: each Choice(k) task picks TA or TB at RUN time by
+    writing decision[k]; the dependency guards read that array, so the
+    DAG's actual route is decided dynamically (guards are evaluated at
+    release time, after the producer body ran)."""
+    src = """
+A        [ type = "collection" ]
+NT       [ type = int ]
+
+Choice(k)
+
+k = 0 .. NT
+
+: A( k )
+
+RW D <- (k == 0) ? A( k )
+     <- (k > 0 && decision[k-1] == 1) ? D TA( k-1 )
+     <- (k > 0 && decision[k-1] == 2) ? D TB( k-1 )
+     -> (k < NT && decision[k] == 1) ? D TA( k )
+     -> (k < NT && decision[k] == 2) ? D TB( k )
+     -> (k == NT) ? A( k )
+
+BODY
+{
+    if k < NT:
+        decision[k] = choose(k)
+        # the not-taken branch task never executes: discount it
+        # (reference choice.jdf:67,86 does the same from TA/TB)
+        this_task.taskpool.addto_nb_tasks(-1)
+    D += 1.0
+}
+END
+
+TA(k)
+
+k = 0 .. NT-1
+
+: A( k )
+
+RW D <- D Choice( k )
+     -> D Choice( k+1 )
+
+BODY
+{
+    took["A"].inc()
+}
+END
+
+TB(k)
+
+k = 0 .. NT-1
+
+: A( k )
+
+RW D <- D Choice( k )
+     -> D Choice( k+1 )
+
+BODY
+{
+    took["B"].inc()
+}
+END
+"""
+    NT = 9
+    rng = np.random.default_rng(7)
+    decision = np.zeros(NT + 1, dtype=int)
+    took = {"A": Counter(), "B": Counter()}
+    choices = [int(rng.integers(1, 3)) for _ in range(NT)]
+
+    jdf = compile_jdf(src, "choice", namespace={
+        "decision": decision, "took": took,
+        "choose": lambda k: choices[k]})
+    dc = LocalCollection("A", shape=(1,), init=lambda k: np.zeros(1))
+    tp = jdf.new(A=dc, NT=NT)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=60)
+    # every step routed through exactly the chosen class
+    assert took["A"].v == sum(1 for c in choices if c == 1)
+    assert took["B"].v == sum(1 for c in choices if c == 2)
+    # the datum passed through NT+1 Choice tasks
+    np.testing.assert_allclose(dc.data_of(NT).newest_copy().payload, NT + 1)
+
+
+def test_local_indices(ctx):
+    """local-indices: definitions declared BEFORE the parameter and used
+    in its range (reference zero/nt pattern)."""
+    src = """
+A  [ type = "collection" ]
+NT [ type = int ]
+
+t(k)
+
+zero = 0
+last = NT - 1
+k = zero .. last
+
+: A( k )
+
+RW X <- A( k )
+     -> A( k )
+
+BODY
+{
+    X[:] = k + last
+}
+END
+"""
+    jdf = compile_jdf(src, "locidx")
+    dc = LocalCollection("A", shape=(1,), init=lambda k: np.zeros(1))
+    tp = jdf.new(A=dc, NT=5)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=60)
+    for k in range(5):
+        np.testing.assert_allclose(dc.data_of(k).newest_copy().payload, k + 4)
+
+
+def test_globals_visible_in_bodies(ctx):
+    """JDF scalar globals are visible inside BODY blocks (C globals in
+    the reference's generated code); collections are not passed."""
+    src = """
+A  [ type = "collection" ]
+NT [ type = int ]
+SCALE [ type = float default = 2.5 ]
+
+t(k)
+
+k = 0 .. NT-1
+
+: A( k )
+
+RW X <- A( k )
+     -> A( k )
+
+BODY
+{
+    X[:] = k * SCALE + NT
+}
+END
+"""
+    jdf = compile_jdf(src, "glob")
+    dc = LocalCollection("A", shape=(1,), init=lambda k: np.zeros(1))
+    tp = jdf.new(A=dc, NT=4)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=60)
+    for k in range(4):
+        np.testing.assert_allclose(
+            dc.data_of(k).newest_copy().payload, k * 2.5 + 4)
+
+
+def test_global_shadowed_by_flow_and_local(ctx):
+    """A scalar global whose name matches a flow or local must NOT clobber
+    the flow/local binding inside the body (inner scope wins)."""
+    src = """
+A  [ type = "collection" ]
+X  [ type = int default = 7 ]
+m  [ type = int default = 9 ]
+NT [ type = int ]
+
+t(k)
+
+k = 0 .. NT-1
+m = k + 1
+
+: A( k )
+
+RW X <- A( k )
+     -> A( k )
+
+BODY
+{
+    X[:] = m * 10.0
+}
+END
+"""
+    jdf = compile_jdf(src, "shadow")
+    dc = LocalCollection("A", shape=(1,), init=lambda k: np.zeros(1))
+    tp = jdf.new(A=dc, NT=3)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=60)
+    for k in range(3):
+        # X is the flow's array (writable), m is the local k+1, not 9
+        np.testing.assert_allclose(
+            dc.data_of(k).newest_copy().payload, (k + 1) * 10.0)
+
+
+def test_use_globals_collision_rejected():
+    """Explicit builder misuse: use_globals colliding with a flow name
+    raises at taskpool construction."""
+    from parsec_tpu.dsl.ptg import PTG, INOUT
+
+    ptg = PTG("clash")
+    t = ptg.task_class("t", k="0 .. 1")
+    t.flow("X", INOUT, "<- D(k)", "-> D(k)")
+    t.use_globals("X")
+    t.body(cpu=lambda X, k: None)
+    dc = LocalCollection("D", shape=(1,), init=lambda k: np.zeros(1))
+    with pytest.raises(ValueError, match="collide"):
+        ptg.taskpool(D=dc, X=5)
+
+
+def test_multisize_bcast(ctx):
+    """multisize_bcast shape: one task broadcasts to consumer classes of
+    different execution-space sizes via two range deps."""
+    src = """
+A  [ type = "collection" ]
+NS [ type = int ]
+NL [ type = int ]
+
+src()
+
+: A( 0 )
+
+RW X <- A( 0 )
+     -> X small( 0 .. NS-1 )
+     -> X large( 0 .. NL-1 )
+
+BODY
+{
+    X += 1.0
+}
+END
+
+small(i)
+
+i = 0 .. NS-1
+
+: A( 0 )
+
+READ X <- X src()
+
+BODY
+{
+    seen.inc()
+}
+END
+
+large(i)
+
+i = 0 .. NL-1
+
+: A( 0 )
+
+READ X <- X src()
+
+BODY
+{
+    seen.inc()
+}
+END
+"""
+    seen = Counter()
+    jdf = compile_jdf(src, "msbcast", namespace={"seen": seen})
+    dc = LocalCollection("A", shape=(2,), init=lambda k: np.zeros(2))
+    tp = jdf.new(A=dc, NS=3, NL=11)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=60)
+    assert seen.v == 3 + 11

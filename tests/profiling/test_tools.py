@@ -75,3 +75,20 @@ def test_check_comms_pass_and_fail(tmp_path, capsys):
     assert tools_main(["check-comms", str(path),
                        "--expect", "MPI_ACTIVATE:nb=5"]) == 1
     assert "FAIL" in capsys.readouterr().err
+    # malformed --expect specs: usage error (exit 2), not a traceback
+    assert tools_main(["check-comms", str(path), "--expect", "MPI_ACTIVATE"]) == 2
+    assert tools_main(["check-comms", str(path),
+                       "--expect", "MPI_ACTIVATE:count=5"]) == 2
+    assert tools_main(["check-comms", str(path),
+                       "--expect", "MPI_ACTIVATE:nb=x"]) == 2
+    capsys.readouterr()
+
+
+def test_spans_tolerate_missing_pid_tid(tmp_path, capsys):
+    """Legal Chrome traces may omit pid/tid; info must not crash."""
+    evs = [{"name": "op", "ph": "B", "ts": 1.0},
+           {"name": "op", "ph": "E", "ts": 5.0}]
+    path = tmp_path / "bare.json"
+    path.write_text(json.dumps(evs))  # bare event array form
+    assert tools_main(["info", str(path)]) == 0
+    assert "op" in capsys.readouterr().out

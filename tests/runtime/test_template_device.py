@@ -30,3 +30,21 @@ def test_explicit_selection_attaches_and_executes():
         assert tdev.stats["executed_tasks"] == 1
     finally:
         ctx.fini()
+
+
+def test_wrong_output_count_is_reported():
+    """A body returning N outputs for M writable flows raises the same
+    explicit ValueError as the CPU path (not a bare StopIteration)."""
+    from parsec_tpu.core.lifecycle import HookReturn
+
+    ctx = Context(nb_cores=2, devices=["tpu", "template"])
+    try:
+        d1 = data_create("a", payload=np.zeros(2))
+        d2 = data_create("b", payload=np.zeros(2))
+        tp = DTDTaskpool(ctx)
+        # two writable flows, body returns one value
+        tp.insert_task({DEV_TEMPLATE: lambda x, y: x + 1.0},
+                       (d1, INOUT), (d2, INOUT))
+        assert tp.wait(timeout=30)  # error contained, taskpool completes
+    finally:
+        ctx.fini()
