@@ -1,0 +1,181 @@
+// Binary profiling tracer: per-stream native event buffers with
+// steady-clock nanosecond timestamps, dumped to a compact binary file.
+// This is the role of the reference's dbp tracer
+// (/root/reference/parsec/profiling.c: per-thread buffers, dictionary of
+// event classes, begin/end key pairs, binary .prof files) — re-designed:
+// fixed-size little-endian records and a Python-side sidecar for the
+// dictionary, instead of in-file string tables.
+//
+// Threading model: one stream per thread (the caller guarantees a stream
+// is only logged to by its owning thread, as in the reference).  dump()
+// may run concurrently with logging: streams store records in fixed-size
+// blocks that NEVER move once allocated (no vector reallocation), the
+// per-stream committed count is published with release semantics, and a
+// record's fields are fully written before the count covering it — so a
+// concurrent dump sees a consistent prefix of each stream.
+//
+// Record layout (40 bytes, little-endian):
+//   int32  stream_id
+//   int32  keyword_id    (dictionary index, Python-side names)
+//   int32  phase         (0=begin 1=end 2=instant 3=counter)
+//   int32  reserved
+//   int64  ts_ns         (steady clock, offset from tracer creation)
+//   int64  event_id      (caller-chosen: task id, byte count, ...)
+//   int64  info          (second payload slot)
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <new>
+#include <vector>
+
+namespace {
+
+constexpr size_t kBlock = 4096;  // records per block
+
+struct Record {
+    int32_t stream_id;
+    int32_t keyword_id;
+    int32_t phase;
+    int32_t reserved;
+    int64_t ts_ns;
+    int64_t event_id;
+    int64_t info;
+};
+static_assert(sizeof(Record) == 40, "record must be 40 bytes");
+
+struct Stream {
+    std::vector<Record*> blocks;  // guarded by bmu; blocks never move
+    std::mutex bmu;
+    std::atomic<size_t> committed{0};
+    int32_t id;
+
+    ~Stream() {
+        for (Record* b : blocks) delete[] b;
+    }
+};
+
+struct Tracer {
+    std::chrono::steady_clock::time_point t0;
+    std::vector<Stream*> streams;
+    std::mutex mu;  // guards stream registration + dump
+
+    Tracer() : t0(std::chrono::steady_clock::now()) {}
+    ~Tracer() {
+        for (Stream* s : streams) delete s;
+    }
+};
+
+int64_t now_ns(const Tracer* t) {
+    return std::chrono::duration_cast<std::chrono::nanoseconds>(
+               std::chrono::steady_clock::now() - t->t0)
+        .count();
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pt_tracer_new() { return new (std::nothrow) Tracer(); }
+
+void pt_tracer_destroy(void* tp) { delete static_cast<Tracer*>(tp); }
+
+// Register a stream (one per logging thread). Returns the stream handle.
+void* pt_stream_new(void* tp) {
+    Tracer* t = static_cast<Tracer*>(tp);
+    Stream* s = new (std::nothrow) Stream();
+    if (s == nullptr) return nullptr;
+    std::lock_guard<std::mutex> g(t->mu);
+    s->id = static_cast<int32_t>(t->streams.size());
+    t->streams.push_back(s);
+    return s;
+}
+
+int32_t pt_stream_id(void* sp) { return static_cast<Stream*>(sp)->id; }
+
+// Append one event. Only the owning thread may call this for a given
+// stream; concurrent dumps see a consistent committed prefix.
+void pt_log(void* tp, void* sp, int32_t keyword, int32_t phase,
+            int64_t event_id, int64_t info) {
+    Tracer* t = static_cast<Tracer*>(tp);
+    Stream* s = static_cast<Stream*>(sp);
+    size_t n = s->committed.load(std::memory_order_relaxed);  // single writer
+    if (n % kBlock == 0) {
+        Record* blk = new (std::nothrow) Record[kBlock];
+        if (blk == nullptr) return;  // drop the event under OOM
+        std::lock_guard<std::mutex> g(s->bmu);
+        s->blocks.push_back(blk);
+    }
+    // no lock needed to index: only this (owner) thread mutates blocks,
+    // and dump() copies the vector under bmu
+    Record* r = s->blocks[n / kBlock] + (n % kBlock);
+    r->stream_id = s->id;
+    r->keyword_id = keyword;
+    r->phase = phase;
+    r->reserved = 0;
+    r->ts_ns = now_ns(t);
+    r->event_id = event_id;
+    r->info = info;
+    s->committed.store(n + 1, std::memory_order_release);
+}
+
+int64_t pt_total_events(void* tp) {
+    Tracer* t = static_cast<Tracer*>(tp);
+    std::lock_guard<std::mutex> g(t->mu);
+    int64_t n = 0;
+    for (Stream* s : t->streams)
+        n += static_cast<int64_t>(s->committed.load(std::memory_order_acquire));
+    return n;
+}
+
+// Dump all committed records to [path]. File layout:
+//   8 bytes magic "PBTRACE1"
+//   int64 record_count
+//   records...
+// The per-stream counts are snapshotted ONCE before the header is
+// written, so the header always matches the records that follow even if
+// logging continues concurrently. Returns records written, -1 on error.
+int64_t pt_dump(void* tp, const char* path) {
+    Tracer* t = static_cast<Tracer*>(tp);
+    std::lock_guard<std::mutex> g(t->mu);
+    FILE* f = std::fopen(path, "wb");
+    if (f == nullptr) return -1;
+
+    std::vector<std::pair<Stream*, size_t>> snap;
+    int64_t total = 0;
+    for (Stream* s : t->streams) {
+        size_t n = s->committed.load(std::memory_order_acquire);
+        snap.emplace_back(s, n);
+        total += static_cast<int64_t>(n);
+    }
+
+    const char magic[8] = {'P', 'B', 'T', 'R', 'A', 'C', 'E', '1'};
+    if (std::fwrite(magic, 1, 8, f) != 8 ||
+        std::fwrite(&total, sizeof(total), 1, f) != 1) {
+        std::fclose(f);
+        return -1;
+    }
+    int64_t written = 0;
+    for (auto& [s, n] : snap) {
+        std::vector<Record*> blocks;
+        {
+            std::lock_guard<std::mutex> bg(s->bmu);
+            blocks = s->blocks;  // block pointers are stable
+        }
+        for (size_t off = 0; off < n; off += kBlock) {
+            size_t chunk = (n - off) < kBlock ? (n - off) : kBlock;
+            if (std::fwrite(blocks[off / kBlock], sizeof(Record), chunk, f) != chunk) {
+                std::fclose(f);
+                return -1;
+            }
+            written += static_cast<int64_t>(chunk);
+        }
+    }
+    std::fclose(f);
+    return written;
+}
+
+}  // extern "C"

@@ -484,6 +484,14 @@ class _Parser:
 # lowering to the PTG builder (the jdf2c analogue)
 # ---------------------------------------------------------------------------
 
+def scalar_globals_for(tc: JDFTaskClass, scalar_globals: List[str]) -> List[str]:
+    """Scalar globals visible in this class's bodies: locals and flows
+    shadow globals (C scoping: inner wins).  Single source of truth for
+    both the runtime front-end and the jdfc code generator."""
+    shadowed = {n for n, _ in tc.decls} | {f.name for f in tc.flows}
+    return [n for n in scalar_globals if n not in shadowed]
+
+
 def uses_this_task(code: str) -> bool:
     """True when the body code references the ``this_task`` identifier
     (real NAME tokens only — not comments or string literals)."""
@@ -558,7 +566,6 @@ class JDF:
             pc = ptg.task_class(tc.name)
             pc.properties.update(tc.props)
             params = set(tc.params)
-            local_names = {n for n, _ in tc.decls}
             for name, expr in tc.decls:
                 if name in params:
                     pc.param(name, expr)
@@ -568,11 +575,7 @@ class JDF:
                 pc.affinity(tc.partitioning)
             for f in tc.flows:
                 pc.flow(f.name, _MODES[f.mode], *f.deps)
-            # scalar globals shadowed by a local or a flow keep the
-            # local/flow binding in bodies (C scoping: inner wins)
-            flow_names = {f.name for f in tc.flows}
-            body_globals = [n for n in scalar_globals
-                            if n not in local_names and n not in flow_names]
+            body_globals = scalar_globals_for(tc, scalar_globals)
             pc.use_globals(*body_globals)
             if tc.priority:
                 pc.priority(tc.priority)

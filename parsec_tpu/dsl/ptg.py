@@ -584,9 +584,11 @@ class PTGTaskpool(Taskpool):
         for pc in self.ptg.classes.values():
             undefined = 0
             for loc in self._local_space(pc):
-                if self._is_startup(pc, loc):
+                if pc.goal_of(loc, self.constants) != 0:
+                    continue
+                if self._is_startup(pc, loc, goal_known_zero=True):
                     out.append(self._make_task(pc, loc))
-                elif pc.goal_of(loc, self.constants) == 0:
+                else:
                     undefined += 1
             if undefined:
                 # goal 0 but some readable flow had no matched input dep:
@@ -599,7 +601,8 @@ class PTGTaskpool(Taskpool):
                     "add an explicit '<- NONE' fallback", pc.name, undefined)
         return out
 
-    def _is_startup(self, pc: PTGTaskClass, loc: Tuple) -> bool:
+    def _is_startup(self, pc: PTGTaskClass, loc: Tuple,
+                    goal_known_zero: bool = False) -> bool:
         """A task starts immediately only when its dependency goal is zero
         AND every readable flow that declares input deps has a guard-true
         one right now.  With *dynamic* guards (reference choice.jdf: guards
@@ -607,7 +610,7 @@ class PTGTaskpool(Taskpool):
         be false at enqueue time — such a task is NOT a source; its
         producer releases it later, re-evaluating the goal then.  Treating
         it as startup would execute it twice (startup + release)."""
-        if pc.goal_of(loc, self.constants) != 0:
+        if not goal_known_zero and pc.goal_of(loc, self.constants) != 0:
             return False
         env = pc.env_of(loc, self.constants)
         for f in pc.flows:

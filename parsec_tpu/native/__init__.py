@@ -36,7 +36,7 @@ _lib = None
 _lib_lock = threading.Lock()
 _build_error: Optional[str] = None
 
-_SOURCES = ["zone.cpp", "graph.cpp"]
+_SOURCES = ["zone.cpp", "graph.cpp", "trace.cpp"]
 
 
 def _newest_mtime(paths: Sequence[str]) -> float:
@@ -113,6 +113,20 @@ def _load():
         lib.pz_graph_order.restype = ctypes.c_int64
         lib.pz_graph_order.argtypes = [ctypes.c_void_p,
                                        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+        # binary tracer
+        lib.pt_tracer_new.restype = ctypes.c_void_p
+        lib.pt_tracer_destroy.argtypes = [ctypes.c_void_p]
+        lib.pt_stream_new.restype = ctypes.c_void_p
+        lib.pt_stream_new.argtypes = [ctypes.c_void_p]
+        lib.pt_stream_id.restype = ctypes.c_int32
+        lib.pt_stream_id.argtypes = [ctypes.c_void_p]
+        lib.pt_log.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                               ctypes.c_int32, ctypes.c_int32,
+                               ctypes.c_int64, ctypes.c_int64]
+        lib.pt_total_events.restype = ctypes.c_int64
+        lib.pt_total_events.argtypes = [ctypes.c_void_p]
+        lib.pt_dump.restype = ctypes.c_int64
+        lib.pt_dump.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         _lib = lib
         return lib
 
@@ -251,6 +265,77 @@ class NativeGraph:
         if getattr(self, "_g", None):
             self._lib.pz_graph_destroy(self._g)
             self._g = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeTracer:
+    """Binary event tracer with native per-stream buffers and
+    steady-clock nanosecond timestamps (reference role:
+    ``parsec/profiling.c`` per-thread dbp buffers).
+
+    A stream is claimed per thread on first log; dumping produces a
+    ``PBTRACE1`` binary file readable by
+    :func:`parsec_tpu.profiling.binary.read_pbt`.  Keyword names live
+    Python-side (:class:`parsec_tpu.profiling.binary.BinaryTrace` pairs
+    the dump with a sidecar).
+    """
+
+    PHASE_BEGIN, PHASE_END, PHASE_INSTANT, PHASE_COUNTER = 0, 1, 2, 3
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native core unavailable: {_build_error}")
+        self._lib = lib
+        self._t = lib.pt_tracer_new()
+        if not self._t:
+            raise MemoryError("pt_tracer_new failed")
+        self._tls = threading.local()
+        self._streams_lock = threading.Lock()
+        self._stream_names: List[str] = []
+
+    def _stream(self):
+        s = getattr(self._tls, "s", None)
+        if s is None:
+            s = self._lib.pt_stream_new(self._t)
+            if not s:
+                raise MemoryError("pt_stream_new failed")
+            self._tls.s = s
+            # place the name at the NATIVE stream id: two threads racing
+            # their first log must not cross-label each other's events
+            sid = self._lib.pt_stream_id(s)
+            with self._streams_lock:
+                while len(self._stream_names) <= sid:
+                    self._stream_names.append("")
+                self._stream_names[sid] = threading.current_thread().name
+        return s
+
+    def log(self, keyword: int, phase: int, event_id: int = 0, info: int = 0) -> None:
+        self._lib.pt_log(self._t, self._stream(), keyword, phase, event_id, info)
+
+    def stream_names(self) -> List[str]:
+        with self._streams_lock:
+            return list(self._stream_names)
+
+    @property
+    def total_events(self) -> int:
+        return self._lib.pt_total_events(self._t)
+
+    def dump(self, path: str) -> int:
+        n = self._lib.pt_dump(self._t, path.encode())
+        if n < 0:
+            raise OSError(f"cannot write trace to {path}")
+        return n
+
+    def close(self) -> None:
+        if getattr(self, "_t", None):
+            self._lib.pt_tracer_destroy(self._t)
+            self._t = None
 
     def __del__(self):  # pragma: no cover
         try:

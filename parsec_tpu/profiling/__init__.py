@@ -10,4 +10,15 @@ from .alperf import AlperfModule
 from .sde import SDEModule
 
 __all__ = ["pins", "Trace", "TaskProfiler", "CommProfiler", "DotGrapher",
-           "dictionary", "sde", "SDEModule", "AlperfModule"]
+           "dictionary", "sde", "SDEModule", "AlperfModule",
+           "BinaryTrace", "BinaryTaskProfiler"]
+
+
+def __getattr__(name):
+    # binary tracer needs the native toolchain: import lazily so the
+    # package loads even where g++ is unavailable
+    if name in ("BinaryTrace", "BinaryTaskProfiler"):
+        from . import binary
+
+        return getattr(binary, name)
+    raise AttributeError(name)

@@ -31,6 +31,12 @@ from typing import Any, Dict, List
 
 
 def load(path: str) -> Dict[str, Any]:
+    with open(path, "rb") as f:
+        head = f.read(8)
+    if head == b"PBTRACE1":  # native binary trace (profiling/binary.py)
+        from .binary import to_chrome_events
+
+        return {"traceEvents": to_chrome_events(path), "metadata": {}}
     with open(path) as f:
         doc = json.load(f)
     if isinstance(doc, list):  # bare event array is also legal Chrome JSON

@@ -1,0 +1,136 @@
+"""Native binary tracer (.pbt) tests (reference profiling.c dbp format +
+dbpreader offline tools)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu import native
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl.ptg import PTG, INOUT
+from parsec_tpu.profiling.tools import main as tools_main
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native core unavailable: {native.build_error()}")
+
+
+def test_roundtrip(tmp_path):
+    from parsec_tpu.profiling.binary import BinaryTrace, read_pbt
+
+    t = BinaryTrace(rank=3)
+    k_a, k_b = t.keyword("alpha"), t.keyword("beta")
+    assert t.keyword("alpha") == k_a  # stable ids
+    t.begin(k_a, event_id=7)
+    t.end(k_a, event_id=7)
+    t.instant(k_b, event_id=42, info=99)
+    t.counter(k_b, 123)
+    path = str(tmp_path / "t.pbt")
+    assert t.dump(path) == 4
+    evs = read_pbt(path)
+    assert [e["ph"] for e in evs] == ["B", "E", "i", "C"]
+    assert evs[0]["name"] == "alpha" and evs[0]["pid"] == 3
+    assert evs[2]["args"] == {"event_id": 42, "info": 99}
+    assert evs[1]["ts"] >= evs[0]["ts"]  # monotonic within a stream
+    t.close()
+
+
+def test_multithreaded_streams(tmp_path):
+    from parsec_tpu.profiling.binary import BinaryTrace, read_pbt
+
+    t = BinaryTrace()
+    k = t.keyword("work")
+    N, NT = 500, 4
+
+    def worker():
+        for i in range(N):
+            t.instant(k, event_id=i)
+
+    threads = [threading.Thread(target=worker) for _ in range(NT)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert t.total_events == N * NT
+    path = str(tmp_path / "mt.pbt")
+    assert t.dump(path) == N * NT
+    evs = read_pbt(path)
+    assert len({e["tid"] for e in evs}) == NT  # one stream per thread
+    t.close()
+
+
+def test_dump_concurrent_with_logging(tmp_path):
+    """dump() while workers log: the header count must match the records
+    in the file (a consistent prefix), crossing block boundaries."""
+    from parsec_tpu.profiling.binary import BinaryTrace, read_pbt
+
+    t = BinaryTrace()
+    k = t.keyword("w")
+    stop = threading.Event()
+
+    def worker():
+        i = 0
+        while not stop.is_set():
+            t.instant(k, event_id=i)
+            i += 1
+
+    threads = [threading.Thread(target=worker) for _ in range(3)]
+    for th in threads:
+        th.start()
+    try:
+        for round_ in range(5):
+            # let buffers cross the 4096-record block boundary
+            while t.total_events < (round_ + 1) * 6000:
+                pass
+            path = str(tmp_path / f"c{round_}.pbt")
+            n = t.dump(path)
+            evs = read_pbt(path)
+            assert len(evs) == n  # header count == records present
+            # per-stream event ids are a gapless prefix 0..m
+            per = {}
+            for e in evs:
+                per.setdefault(e["tid"], []).append(e["args"]["event_id"])
+            for ids in per.values():
+                assert ids == list(range(len(ids)))
+    finally:
+        stop.set()
+        for th in threads:
+            th.join()
+    t.close()
+
+
+def test_binary_task_profiler_and_tools(tmp_path, capsys):
+    """Run a chain under the native profiler; the tools CLI reads .pbt
+    directly."""
+    from parsec_tpu.profiling.binary import BinaryTaskProfiler
+
+    prof = BinaryTaskProfiler()
+    try:
+        dc = LocalCollection("D", shape=(1,), init=lambda k: np.zeros(1))
+        ptg = PTG("chain")
+        step = ptg.task_class("step", k="0 .. N-1")
+        step.affinity("D(0)")
+        step.flow("X", INOUT,
+                  "<- (k == 0) ? D(0) : X step(k-1)",
+                  "-> (k < N-1) ? X step(k+1) : D(0)")
+        step.body(cpu=lambda X, k: X.__iadd__(1.0))
+        ctx = Context(nb_cores=2)
+        try:
+            tp = ptg.taskpool(N=10, D=dc)
+            ctx.add_taskpool(tp)
+            assert tp.wait(timeout=30)
+        finally:
+            ctx.fini()
+        path = str(tmp_path / "task.pbt")
+        n = prof.trace.dump(path)
+        assert n >= 60  # 10 tasks x 3 span pairs
+    finally:
+        prof.uninstall()
+    assert tools_main(["info", path]) == 0
+    out = capsys.readouterr().out
+    assert "exec" in out and "complete_exec" in out
+    out_csv = tmp_path / "spans.csv"
+    assert tools_main(["to-csv", path, "-o", str(out_csv)]) == 0
+    lines = out_csv.read_text().strip().split("\n")
+    assert sum(1 for ln in lines if ln.startswith("exec,")) == 10
