@@ -73,3 +73,46 @@ def launch(
             for r, why, out, err in failed)
         raise RuntimeError(f"{len(failed)}/{nranks} ranks failed:\n{msgs}")
     return results
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI: ``python -m parsec_tpu.comm.launch -n 4 app.py [args...]`` —
+    the ``mpiexec -np N`` analogue. Streams each rank's output after the
+    job completes, prefixed with its rank."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="parsec_tpu.comm.launch",
+        description="run a script as N communicating ranks (mpiexec analogue)")
+    p.add_argument("-n", "--np", dest="nranks", type=int, required=True,
+                   help="number of ranks")
+    p.add_argument("--rdv", help="rendezvous directory (shared fs for "
+                   "multi-host); default: a fresh temp dir")
+    p.add_argument("--timeout", type=float, default=300.0,
+                   help="job-wide timeout in seconds")
+    p.add_argument("argv", nargs=argparse.REMAINDER,
+                   help="script and its arguments")
+    args = p.parse_args(argv)
+    if not args.argv:
+        p.error("no script given")
+    # strip only a LEADING "--" (argparse REMAINDER separator); later "--"
+    # tokens belong to the launched script's own argument parsing
+    cmd = args.argv[1:] if args.argv[0] == "--" else list(args.argv)
+    if not cmd:
+        p.error("no script given")
+    try:
+        results = launch(args.nranks, cmd, rendezvous_dir=args.rdv,
+                         timeout=args.timeout)
+    except RuntimeError as e:
+        print(e, file=sys.stderr)
+        return 1
+    for r, res in enumerate(results):
+        for line in (res.stdout or "").splitlines():
+            print(f"[rank {r}] {line}")
+        for line in (res.stderr or "").splitlines():
+            print(f"[rank {r}] {line}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,7 +16,7 @@ import numpy as np
 
 from ..core.lifecycle import AccessMode
 from ..core.taskpool import Taskpool
-from ..dsl.dtd import DTDTaskpool, IN, INOUT
+from ..dsl.dtd import AFFINITY, DTDTaskpool, IN, INOUT
 from ..dsl.ptg import PTG
 from .matrix import TiledMatrix
 
@@ -87,8 +87,13 @@ def reduce_taskpool(context, A: TiledMatrix,
 
 def reduce_rows(context, A: TiledMatrix, combine_tiles: Callable[[np.ndarray, np.ndarray], Any]) -> list:
     """Row-wise tile reduction: fold each tile row to one tile (reference
-    reduce_row.jdf). Returns list of per-row result arrays."""
-    _require_single_rank(A, "reduce_rows")
+    reduce_row.jdf). Returns list of per-row result arrays.
+
+    Multi-rank: every rank inserts the identical stream; each row's fold
+    executes on the owner of the row's first stored tile (AFFINITY), with
+    remote tiles shipped by the DTD shadow-task protocol — so on each
+    rank the returned list holds results only for the rows it folded
+    (owner-computes), None elsewhere."""
     tp = DTDTaskpool(context, name=f"reduce_row_{A.name}")
     out = [None] * A.mt
     import threading
@@ -109,14 +114,16 @@ def reduce_rows(context, A: TiledMatrix, combine_tiles: Callable[[np.ndarray, np
         args = [(A.data_of(i, j), IN) for j in range(A.nt) if A.stored(i, j)]
         if not args:  # triangular storage: row may hold no tiles
             continue
+        args[0] = (args[0][0], IN | AFFINITY)  # fold on first tile's owner
         tp.insert_task(fold(i), *args, name="reduce_row")
     tp.wait()
     return out
 
 
 def reduce_cols(context, A: TiledMatrix, combine_tiles: Callable[[np.ndarray, np.ndarray], Any]) -> list:
-    """Column-wise tile reduction (reference reduce_col.jdf)."""
-    _require_single_rank(A, "reduce_cols")
+    """Column-wise tile reduction (reference reduce_col.jdf). Multi-rank
+    contract as in :func:`reduce_rows` (owner of the column's first
+    stored tile folds it)."""
     tp = DTDTaskpool(context, name=f"reduce_col_{A.name}")
     out = [None] * A.nt
     import threading
@@ -137,15 +144,10 @@ def reduce_cols(context, A: TiledMatrix, combine_tiles: Callable[[np.ndarray, np
         args = [(A.data_of(i, j), IN) for i in range(A.mt) if A.stored(i, j)]
         if not args:  # triangular storage: column may hold no tiles
             continue
+        args[0] = (args[0][0], IN | AFFINITY)  # fold on first tile's owner
         tp.insert_task(fold(j), *args, name="reduce_col")
     tp.wait()
     return out
 
 
-def _require_single_rank(A: TiledMatrix, what: str) -> None:
-    """Cross-rank tile reads need a comm-backed collection; until then,
-    refuse loudly rather than silently folding fabricated zero tiles."""
-    if A.nodes > 1:
-        raise NotImplementedError(
-            f"{what} over a {A.nodes}-rank distribution requires remote "
-            f"collection reads (planned); run per-rank or gather first")
+

@@ -18,7 +18,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from ..dsl.dtd import DTDTaskpool, IN, INOUT
+from ..dsl.dtd import AFFINITY, DTDTaskpool, IN, INOUT
 from .matrix import TiledMatrix
 
 
@@ -50,11 +50,11 @@ def redistribute(
         raise ValueError("empty redistribution window")
     if ia + m > S.m or ja + n > S.n or ib + m > T.m or jb + n > T.n:
         raise ValueError("window exceeds matrix bounds")
-    if S.nodes > 1 or T.nodes > 1:
-        raise NotImplementedError(
-            "multi-rank redistribution requires remote collection reads "
-            "(planned); single-process redistribution only for now")
 
+    # multi-rank: every rank inserts the identical task stream (DTD
+    # sequential semantics); AFFINITY on the target tile places each task
+    # on T's owner and the shadow-task protocol ships remote source tiles
+    # (reference: redistribute_dtd.c over mpiexec)
     tp = DTDTaskpool(context, name=f"redist_{S.name}_to_{T.name}")
 
     # fast path: identical tiling and aligned offsets → plain tile-wise
@@ -79,7 +79,7 @@ def redistribute(
                 tp.insert_task(
                     copy_tile,
                     (S.data_of(di + r, dj + c), IN),
-                    (T.data_of(oi + r, oj + c), INOUT),
+                    (T.data_of(oi + r, oj + c), INOUT | AFFINITY),
                     name="reshuffle")
         return tp
 
@@ -118,6 +118,6 @@ def redistribute(
                         src[a0 - si * S.mb:a1 - si * S.mb, b0 - sj * S.nb:b1 - sj * S.nb]
 
             args = [(S.data_of(*st), IN) for st in src_tiles]
-            args.append((T.data_of(ti, tj), INOUT))
+            args.append((T.data_of(ti, tj), INOUT | AFFINITY))
             tp.insert_task(body, *args, name="redist")
     return tp

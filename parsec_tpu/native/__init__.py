@@ -316,6 +316,11 @@ class NativeTracer:
         return s
 
     def log(self, keyword: int, phase: int, event_id: int = 0, info: int = 0) -> None:
+        # after close() the native tracer (and every stream handle cached
+        # in TLS) is freed: a straggler logger (e.g. a PINS callback still
+        # subscribed during shutdown) must no-op, not segfault
+        if self._t is None:
+            return
         self._lib.pt_log(self._t, self._stream(), keyword, phase, event_id, info)
 
     def stream_names(self) -> List[str]:
@@ -324,9 +329,13 @@ class NativeTracer:
 
     @property
     def total_events(self) -> int:
+        if self._t is None:
+            return 0
         return self._lib.pt_total_events(self._t)
 
     def dump(self, path: str) -> int:
+        if self._t is None:
+            raise OSError("tracer is closed")
         n = self._lib.pt_dump(self._t, path.encode())
         if n < 0:
             raise OSError(f"cannot write trace to {path}")

@@ -23,6 +23,7 @@ tables.
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 from typing import Any, Dict, List, Optional
@@ -99,8 +100,10 @@ class BinaryTrace:
 class BinaryTaskProfiler:
     """PINS module: task lifecycle into a BinaryTrace (native buffers).
 
-    ``event_id`` carries a stable per-task token (the task key hash) so
-    offline analysis can match begin/end pairs per task."""
+    ``event_id`` carries a stable per-task token — a monotonically
+    assigned sequence number, stamped on the task at its first event —
+    so offline analysis can match begin/end pairs per task even after
+    objects are garbage-collected (``id()`` would be reused)."""
 
     def __init__(self, trace: Optional[BinaryTrace] = None):
         self.trace = trace or BinaryTrace()
@@ -108,19 +111,27 @@ class BinaryTaskProfiler:
         self._k_exec = k("exec")
         self._k_prep = k("prepare_input")
         self._k_complete = k("complete_exec")
+        self._seq = itertools.count(1)
         self._subs = []
 
         def sub(site, cb):
             pins.subscribe(site, cb)
             self._subs.append((site, cb))
 
+        def tok(task) -> int:
+            prof = task.prof
+            t = prof.get("pbt_token")
+            if t is None:
+                t = prof["pbt_token"] = next(self._seq)
+            return t
+
         t = self.trace
-        sub(pins.EXEC_BEGIN, lambda es, task: t.begin(self._k_exec, id(task)))
-        sub(pins.EXEC_END, lambda es, task: t.end(self._k_exec, id(task)))
-        sub(pins.PREPARE_INPUT_BEGIN, lambda es, task: t.begin(self._k_prep, id(task)))
-        sub(pins.PREPARE_INPUT_END, lambda es, task: t.end(self._k_prep, id(task)))
-        sub(pins.COMPLETE_EXEC_BEGIN, lambda es, task: t.begin(self._k_complete, id(task)))
-        sub(pins.COMPLETE_EXEC_END, lambda es, task: t.end(self._k_complete, id(task)))
+        sub(pins.EXEC_BEGIN, lambda es, task: t.begin(self._k_exec, tok(task)))
+        sub(pins.EXEC_END, lambda es, task: t.end(self._k_exec, tok(task)))
+        sub(pins.PREPARE_INPUT_BEGIN, lambda es, task: t.begin(self._k_prep, tok(task)))
+        sub(pins.PREPARE_INPUT_END, lambda es, task: t.end(self._k_prep, tok(task)))
+        sub(pins.COMPLETE_EXEC_BEGIN, lambda es, task: t.begin(self._k_complete, tok(task)))
+        sub(pins.COMPLETE_EXEC_END, lambda es, task: t.end(self._k_complete, tok(task)))
 
     def uninstall(self) -> None:
         for site, cb in self._subs:

@@ -159,15 +159,9 @@ def test_reduce_triangular_no_crash():
     assert all(c is not None for c in cols)  # every column has a diag tile
 
 
-def test_multirank_matrix_ops_refused():
-    from parsec_tpu.datadist import TwoDimBlockCyclic, redistribute, reduce_rows
-
-    A = TwoDimBlockCyclic(16, 16, 4, 4, p=2, q=2, myrank=0)
-    with Context(nb_cores=1) as ctx:
-        with pytest.raises(NotImplementedError):
-            reduce_rows(ctx, A, lambda a, b: a + b)
-        with pytest.raises(NotImplementedError):
-            redistribute(ctx, A, A)
+# (the former multirank-refusal test is gone: redistribution and the
+# row/col reductions are multi-rank now — see
+# tests/collections/test_redistribute_multirank.py)
 
 
 def test_lhq_priority_order():
