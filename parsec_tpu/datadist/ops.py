@@ -85,6 +85,18 @@ def reduce_taskpool(context, A: TiledMatrix,
     return tp
 
 
+def _check_context_ranks(context, A: TiledMatrix, what: str) -> None:
+    """A collection distributed over N ranks needs a context with exactly
+    N ranks: otherwise remote-owned tiles would be lazily materialized as
+    zeros and silently folded in (or the owner rank would not exist and
+    the taskpool would never quiesce)."""
+    nr = getattr(context, "nranks", 1)
+    if A.nodes not in (1, nr):
+        raise ValueError(
+            f"{what}: {A.name} is distributed over {A.nodes} ranks but the "
+            f"context has {nr}; run one context per rank over a fabric")
+
+
 def reduce_rows(context, A: TiledMatrix, combine_tiles: Callable[[np.ndarray, np.ndarray], Any]) -> list:
     """Row-wise tile reduction: fold each tile row to one tile (reference
     reduce_row.jdf). Returns list of per-row result arrays.
@@ -94,6 +106,7 @@ def reduce_rows(context, A: TiledMatrix, combine_tiles: Callable[[np.ndarray, np
     remote tiles shipped by the DTD shadow-task protocol — so on each
     rank the returned list holds results only for the rows it folded
     (owner-computes), None elsewhere."""
+    _check_context_ranks(context, A, "reduce_rows")
     tp = DTDTaskpool(context, name=f"reduce_row_{A.name}")
     out = [None] * A.mt
     import threading
@@ -124,6 +137,7 @@ def reduce_cols(context, A: TiledMatrix, combine_tiles: Callable[[np.ndarray, np
     """Column-wise tile reduction (reference reduce_col.jdf). Multi-rank
     contract as in :func:`reduce_rows` (owner of the column's first
     stored tile folds it)."""
+    _check_context_ranks(context, A, "reduce_cols")
     tp = DTDTaskpool(context, name=f"reduce_col_{A.name}")
     out = [None] * A.nt
     import threading

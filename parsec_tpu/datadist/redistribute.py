@@ -55,6 +55,10 @@ def redistribute(
     # sequential semantics); AFFINITY on the target tile places each task
     # on T's owner and the shadow-task protocol ships remote source tiles
     # (reference: redistribute_dtd.c over mpiexec)
+    from .ops import _check_context_ranks
+
+    _check_context_ranks(context, S, "redistribute")
+    _check_context_ranks(context, T, "redistribute")
     tp = DTDTaskpool(context, name=f"redist_{S.name}_to_{T.name}")
 
     # fast path: identical tiling and aligned offsets → plain tile-wise

@@ -299,10 +299,10 @@ class NativeTracer:
         self._streams_lock = threading.Lock()
         self._stream_names: List[str] = []
 
-    def _stream(self):
+    def _stream(self, t=None):
         s = getattr(self._tls, "s", None)
         if s is None:
-            s = self._lib.pt_stream_new(self._t)
+            s = self._lib.pt_stream_new(t if t is not None else self._t)
             if not s:
                 raise MemoryError("pt_stream_new failed")
             self._tls.s = s
@@ -316,12 +316,15 @@ class NativeTracer:
         return s
 
     def log(self, keyword: int, phase: int, event_id: int = 0, info: int = 0) -> None:
-        # after close() the native tracer (and every stream handle cached
-        # in TLS) is freed: a straggler logger (e.g. a PINS callback still
-        # subscribed during shutdown) must no-op, not segfault
-        if self._t is None:
+        # close() only detaches the handle (native buffers are destroyed
+        # when this object is collected, see close()): snapshotting the
+        # handle here makes a concurrent close() safe — a straggler logger
+        # (e.g. a PINS callback still subscribed during shutdown) either
+        # sees None and no-ops, or logs into still-live native memory
+        t = self._t
+        if t is None:
             return
-        self._lib.pt_log(self._t, self._stream(), keyword, phase, event_id, info)
+        self._lib.pt_log(t, self._stream(t), keyword, phase, event_id, info)
 
     def stream_names(self) -> List[str]:
         with self._streams_lock:
@@ -342,12 +345,22 @@ class NativeTracer:
         return n
 
     def close(self) -> None:
-        if getattr(self, "_t", None):
-            self._lib.pt_tracer_destroy(self._t)
+        """Detach: further log/dump calls no-op/raise.  The native buffers
+        are destroyed only when this object is garbage-collected — a
+        concurrently-racing logger thread (which necessarily still holds a
+        reference via its bound callback) can therefore never touch freed
+        memory."""
+        t = getattr(self, "_t", None)
+        if t:
             self._t = None
+            self._closed_handle = t
 
     def __del__(self):  # pragma: no cover
         try:
-            self.close()
+            t = getattr(self, "_t", None) or getattr(self, "_closed_handle", None)
+            if t:
+                self._t = None
+                self._closed_handle = None
+                self._lib.pt_tracer_destroy(t)
         except Exception:
             pass

@@ -234,6 +234,18 @@ class ParamRegistry:
         i = 0
         while i < len(argv):
             a = argv[i]
+            if a == "--parsec-help" or a.startswith("--parsec-help="):
+                # reference: ``parsec.c:413-417`` prints the registered
+                # parameter catalog and continues
+                _, _, lvl = a.partition("=")
+                try:
+                    max_level = int(lvl) if lvl else 9
+                except ValueError:
+                    print(f"--parsec-help: ignoring non-numeric level {lvl!r}")
+                    max_level = 9
+                self.print_help(max_level=max_level)
+                i += 1
+                continue
             if a in ("--mca", "--parsec") and i + 2 < len(argv):
                 key, val = argv[i + 1], argv[i + 2]
                 fw, _, nm = key.partition("_")
@@ -250,6 +262,19 @@ class ParamRegistry:
         return out
 
     # -- introspection ----------------------------------------------------
+    def print_help(self, max_level: int = 9, file=None) -> None:
+        """Human-readable parameter catalog (``--parsec-help``)."""
+        import sys
+
+        f = file or sys.stdout
+        rows = self.dump(max_level=max_level)
+        print(f"{len(rows)} registered MCA parameters "
+              f"(set via --mca/--parsec pairs, PARSEC_MCA_* env, or files):",
+              file=f)
+        for r in rows:
+            print(f"  {r['name']:<40} = {r['value']!r:<16} "
+                  f"[{r['type']}, {r['source']}] {r['help']}", file=f)
+
     def dump(self, max_level: int = 9) -> List[Dict[str, Any]]:
         with self._lock:
             return [

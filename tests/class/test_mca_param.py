@@ -51,3 +51,16 @@ def test_dump_contains_registered():
     entries = {e["name"]: e for e in mca_param.dump()}
     assert "testfw_dumped" in entries
     assert entries["testfw_dumped"]["help"] == "the help"
+
+
+def test_parsec_help_prints_catalog(capsys):
+    from parsec_tpu.utils.mca_param import ParamRegistry
+
+    reg = ParamRegistry()
+    reg.register("runtime", "num_cores", 4, help="worker thread count")
+    left = reg.parse_cmdline(["prog", "--parsec-help", "--mca", "sched", "gd", "keep"])
+    assert left == ["prog", "keep"]
+    out = capsys.readouterr().out
+    assert "registered MCA parameters" in out
+    assert "runtime_num_cores" in out and "worker thread count" in out
+    assert reg.get("mca", "sched") == "gd"

@@ -97,3 +97,18 @@ def test_reduce_rows_multirank():
                                            err_msg=f"row {i} on rank {rank}")
             else:
                 assert rows[i] is None
+
+
+def test_rank_mismatch_refused():
+    """A 4-rank distribution under a 1-rank context must refuse loudly
+    (remote tiles would silently materialize as zeros)."""
+    from parsec_tpu import Context
+    from parsec_tpu.datadist import TwoDimBlockCyclic
+    from parsec_tpu.datadist.ops import reduce_rows
+
+    A = TwoDimBlockCyclic(16, 16, 4, 4, p=2, q=2, myrank=0)
+    with Context(nb_cores=1) as ctx:
+        with pytest.raises(ValueError, match="distributed over 4 ranks"):
+            reduce_rows(ctx, A, lambda a, b: a + b)
+        with pytest.raises(ValueError, match="redistribute"):
+            redistribute(ctx, A, A)
