@@ -111,6 +111,7 @@ class Context:
         self._active_taskpools = 0
         self._started = False
         self._shutdown = False
+        self._fini_cbs = []
         self._tls = threading.local()
 
         self._threads: List[threading.Thread] = []
@@ -333,8 +334,20 @@ class Context:
             tasks = [tasks]
         scheduling.schedule_ready(self, es, tasks, distance)
 
+    def on_fini(self, cb) -> None:
+        """Register a teardown callback, run at the start of :meth:`fini`
+        while worker statistics are still intact (reference: PINS modules
+        report at thread-fini time)."""
+        self._fini_cbs.append(cb)
+
     def fini(self) -> None:
         """Reference ``parsec_fini``: drain and tear down."""
+        for cb in getattr(self, "_fini_cbs", []):
+            try:
+                cb()
+            except Exception as e:  # teardown reports must not mask fini
+                debug.warning("on_fini callback failed: %s", e)
+        self._fini_cbs = []
         with self._cv:
             self._shutdown = True
             self._cv.notify_all()

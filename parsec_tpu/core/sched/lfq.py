@@ -82,6 +82,7 @@ class SchedLFQ(Scheduler):
                 continue
             with vlk:
                 if vdq:
+                    es.stats["steals"] += 1
                     return vdq.pop()  # victim's FIFO end
         return None
 

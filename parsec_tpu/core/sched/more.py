@@ -52,6 +52,7 @@ class _LocalHeaps(Scheduler):
         for d in range(1, n):
             t = self._pop((es.worker_id + d) % n)
             if t is not None:
+                es.stats["steals"] += 1
                 return t
         return None
 
@@ -170,12 +171,14 @@ class SchedLHQ(Scheduler):
             v = (i + d) % n
             with self._llocks[v]:
                 if self._local[v]:
+                    es.stats["steals"] += 1
                     return self._local[v].pop()
         for gg in range(len(self._group)):
             if gg == g:
                 continue
             with self._glocks[gg]:
                 if self._group[gg]:
+                    es.stats["steals"] += 1
                     return self._group[gg].pop()
         return None
 

@@ -5,6 +5,7 @@ from .data import Coherency, Data, DataCopy, data_create
 from .arena import Arena
 from .datarepo import DataRepo, RepoEntry
 from .collection import DataCollection, LocalCollection
+from . import checkpoint
 from .reshape import DataCopyFuture, ReshapeSpec, get_copy_reshape, materialize
 
 __all__ = [
@@ -21,4 +22,5 @@ __all__ = [
     "ReshapeSpec",
     "get_copy_reshape",
     "materialize",
+    "checkpoint",
 ]

@@ -1,0 +1,124 @@
+"""Live runtime monitor (CLI) — reference ``tools/aggregator_visu``.
+
+The reference ships a Python GUI that polls runtime properties exported
+through a shared-memory dictionary.  Here the :class:`~parsec_tpu.profiling.
+dictionary.Aggregator` streams those properties to a JSONL file from
+inside the running application; this CLI tails that file from *another*
+process and renders a text dashboard with rates.
+
+Usage::
+
+    # in the app
+    from parsec_tpu.profiling import dictionary
+    dictionary.register_context(ctx)
+    agg = dictionary.Aggregator(interval=0.25, path="live.jsonl").start()
+
+    # in another terminal
+    python -m parsec_tpu.profiling.monitor live.jsonl --follow
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+
+def read_samples(path: str) -> List[Dict[str, Any]]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail write of a live file
+    return out
+
+
+def render(samples: List[Dict[str, Any]]) -> str:
+    """Latest values plus rates over the sampling window."""
+    if not samples:
+        return "(no samples)"
+    last = samples[-1]
+    lines = [f"sample @ t={last.get('t', 0):.3f} ({len(samples)} samples)"]
+    prev = samples[-2] if len(samples) > 1 else None
+    dt = (last.get("t", 0) - prev.get("t", 0)) if prev else 0.0
+    for key in sorted(last):
+        if key == "t":
+            continue
+        val = last[key]
+        rate = ""
+        if prev and dt > 0 and isinstance(val, (int, float)) \
+                and isinstance(prev.get(key), (int, float)):
+            rate = f"  ({(val - prev[key]) / dt:+.1f}/s)"
+        lines.append(f"  {key:<44} = {_fmt(val)}{rate}")
+    return "\n".join(lines)
+
+
+def _fmt(v: Any) -> str:
+    s = json.dumps(v) if isinstance(v, (dict, list)) else repr(v)
+    return s if len(s) <= 60 else s[:57] + "..."
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="parsec_tpu.profiling.monitor",
+        description="tail an Aggregator JSONL stream (aggregator_visu role)")
+    p.add_argument("path", help="JSONL file written by dictionary.Aggregator")
+    p.add_argument("--follow", "-f", action="store_true",
+                   help="keep polling and re-rendering")
+    p.add_argument("--interval", type=float, default=0.5)
+    p.add_argument("--max-updates", type=int, default=0,
+                   help="stop after N renders in --follow mode (0 = forever)")
+    args = p.parse_args(argv)
+    updates = 0
+    # incremental tail state: render() needs only the trailing samples,
+    # so parse appended bytes per poll instead of rereading the file
+    offset = 0
+    count = 0
+    window: List[Dict[str, Any]] = []
+    partial = ""
+    while True:
+        try:
+            with open(args.path) as f:
+                f.seek(offset)
+                chunk = f.read()
+                offset = f.tell()
+        except OSError as e:
+            print(f"cannot read {args.path}: {e}", file=sys.stderr)
+            return 1
+        lines = (partial + chunk).split("\n")
+        partial = lines.pop()  # last element: incomplete tail (or "")
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                window.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+            count += 1
+            if len(window) > 2:
+                window.pop(0)
+        print(render_window(window, count))
+        updates += 1
+        if not args.follow or (args.max_updates and updates >= args.max_updates):
+            return 0
+        time.sleep(args.interval)
+
+
+def render_window(window: List[Dict[str, Any]], total: int) -> str:
+    """Render from the trailing one-or-two samples + a running total."""
+    if not window:
+        return "(no samples)"
+    out = render(window)
+    return out.replace(f"({len(window)} samples)", f"({total} samples)")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,99 @@
+"""print_steals PINS module + live monitor CLI (reference
+mca/pins/print_steals and tools/aggregator_visu)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl.ptg import PTG, IN, INOUT
+from parsec_tpu.profiling.monitor import main as monitor_main, render
+from parsec_tpu.profiling.print_steals import PrintSteals
+
+
+def _fan_tp(n):
+    """A wide fan: one src, n independent workers — guarantees stealing
+    under lfq (all tasks land on the scheduling worker's local queue)."""
+    dc = LocalCollection("D", shape=(4,), init=lambda k: np.zeros(4))
+    ptg = PTG("fan")
+    src = ptg.task_class("src")
+    src.affinity("D(0)")
+    src.flow("X", INOUT, "<- D(0)", "-> X work(0 .. N-1)")
+    src.body(cpu=lambda X: X.__iadd__(1.0))
+    work = ptg.task_class("work", w="0 .. N-1")
+    work.affinity("D(0)")
+    work.flow("X", IN, "<- X src()")
+
+    def busy(X, w):
+        acc = 0.0
+        for _ in range(2000):
+            acc += float(X[0])
+        return None
+
+    work.body(cpu=busy)
+    return ptg.taskpool(N=n, D=dc)
+
+
+def test_print_steals_report():
+    ctx = Context(nb_cores=4)
+    mod = PrintSteals(ctx, auto=True)
+    tp = _fan_tp(64)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=60)
+    rows = mod.snapshot()
+    assert len(rows) == 4
+    assert sum(r["executed"] for r in rows) == 65
+    assert sum(r["steals"] for r in rows) > 0  # workers actually stole
+    rep = mod.report()
+    assert "total steals" in rep and "worker" in rep
+    ctx.fini()  # auto report must not raise
+
+
+def test_on_fini_callback_order():
+    ctx = Context(nb_cores=2)
+    seen = []
+    ctx.on_fini(lambda: seen.append(len(ctx.streams)))
+    ctx.fini()
+    assert seen == [2]  # ran before teardown
+
+
+def test_monitor_render_and_cli(tmp_path, capsys):
+    samples = [
+        {"t": 1.0, "runtime.pending_tasks": 10, "sde.X": 0},
+        {"t": 2.0, "runtime.pending_tasks": 4, "sde.X": 100},
+    ]
+    path = tmp_path / "live.jsonl"
+    path.write_text("\n".join(json.dumps(s) for s in samples)
+                    + "\n{\"torn")  # torn tail line must be tolerated
+    out = render(samples)
+    assert "runtime.pending_tasks" in out and "(-6.0/s)" in out
+    assert monitor_main([str(path)]) == 0
+    cli_out = capsys.readouterr().out
+    assert "2 samples" in cli_out and "+100.0/s" in cli_out
+
+
+def test_monitor_with_live_aggregator(tmp_path):
+    """End-to-end: aggregator streams a real context's properties, the
+    monitor reads them back."""
+    from parsec_tpu.profiling import dictionary
+
+    path = str(tmp_path / "agg.jsonl")
+    ctx = Context(nb_cores=2)
+    try:
+        dictionary.register_context(ctx)
+        agg = dictionary.Aggregator(interval=0.02, path=path).start()
+        tp = _fan_tp(16)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=60)
+        agg.stop()
+    finally:
+        ctx.fini()
+        dictionary.unregister_property("runtime.pending_tasks")
+        dictionary.unregister_property("runtime.executed_per_worker")
+    from parsec_tpu.profiling.monitor import read_samples
+
+    samples = read_samples(path)
+    assert samples
+    assert any("runtime.pending_tasks" in s for s in samples)

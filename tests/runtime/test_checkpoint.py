@@ -1,0 +1,142 @@
+"""Checkpoint/resume (greenfield — reference has none, SURVEY §5.4): a
+quiesced taskpool's collections persist per-rank and restore across
+contexts, runs, and even rank layouts."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.data.checkpoint import manifest, restore, save, shards_of
+from parsec_tpu.datadist import TwoDimBlockCyclic
+from parsec_tpu.dsl import compile_jdf
+
+
+CHAIN = """
+mydata  [ type = "collection" ]
+NB      [ type = int ]
+
+Task(k)
+
+k = 0 .. NB
+
+: mydata( 0 )
+
+RW  A <- (k == 0)  ? mydata( 0 ) : A Task( k-1 )
+      -> (k == NB) ? mydata( 0 ) : A Task( k+1 )
+
+BODY
+{
+    A += 1.0
+}
+END
+"""
+
+
+def test_roundtrip(tmp_path):
+    dc = LocalCollection("D", shape=(4,), init=lambda k: np.zeros(4))
+    for k in range(3):
+        dc.data_of(k).newest_copy().payload[:] = k + 1.0
+    A = TwoDimBlockCyclic(8, 8, 4, 4, name="A")
+    for (i, j) in A.local_tiles():
+        A.data_of(i, j).newest_copy().payload[:] = 10 * i + j
+    path = str(tmp_path / "ck")
+    save(path, dc, A, meta={"step": 7})
+
+    # wipe, then restore
+    for k in range(3):
+        dc.data_of(k).newest_copy().payload[:] = 0.0
+    for (i, j) in A.local_tiles():
+        A.data_of(i, j).newest_copy().payload[:] = -1.0
+    n = restore(path, dc, A)
+    assert n == 3 + 4
+    for k in range(3):
+        np.testing.assert_allclose(dc.data_of(k).newest_copy().payload, k + 1.0)
+    for (i, j) in A.local_tiles():
+        np.testing.assert_allclose(A.data_of(i, j).newest_copy().payload, 10 * i + j)
+    m = manifest(path)
+    assert m[0]["meta"] == {"step": 7} and m[0]["tiles"] == 7
+
+
+def test_resume_across_contexts(tmp_path):
+    """Run half the work, checkpoint, rebuild everything from disk in a
+    NEW context, run the second half: result equals one full run."""
+    jdf = compile_jdf(CHAIN, "chain")
+    path = str(tmp_path / "mid")
+
+    # phase 1
+    dc1 = LocalCollection("mydata", shape=(1,), init=lambda k: np.zeros(1))
+    with Context(nb_cores=2) as ctx:
+        tp = jdf.new(mydata=dc1, NB=9)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=30)
+        save(path, dc1, meta={"completed": 10})
+    del dc1
+
+    # phase 2: fresh process-state equivalent
+    dc2 = LocalCollection("mydata", shape=(1,), init=lambda k: np.zeros(1))
+    assert restore(path, dc2) == 1
+    with Context(nb_cores=2) as ctx:
+        tp = jdf.new(mydata=dc2, NB=9)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=30)
+    np.testing.assert_allclose(dc2.data_of(0).newest_copy().payload, 20.0)
+
+
+def test_elastic_restart_layout_change(tmp_path):
+    """Shards written by a 2-rank layout restore into a single-rank
+    collection (and vice versa): tiles are keyed globally."""
+    M, MB = 16, 4
+    path = str(tmp_path / "elastic")
+    # two "ranks" write their shards
+    for r in range(2):
+        A = TwoDimBlockCyclic(M, M, MB, MB, p=2, q=1, myrank=r, name="A")
+        for (i, j) in A.local_tiles():
+            A.data_of(i, j).newest_copy().payload[:] = 100 * i + j
+        save(path, A, rank=r)
+    assert len(shards_of(path)) == 2
+
+    # restart on ONE rank: all 16 tiles land locally
+    B = TwoDimBlockCyclic(M, M, MB, MB, name="A")
+    assert restore(path, B) == 16
+    for (i, j) in B.local_tiles():
+        np.testing.assert_allclose(
+            B.data_of(i, j).newest_copy().payload, 100 * i + j)
+
+
+def test_restore_missing(tmp_path):
+    dc = LocalCollection("D", shape=(1,), init=lambda k: np.zeros(1))
+    with pytest.raises(FileNotFoundError):
+        restore(str(tmp_path / "nope"), dc)
+
+
+def test_numpy_scalar_keys_and_odd_names(tmp_path):
+    """Keys that are numpy scalars and names containing the old '|'
+    separator must round-trip (regression: repr-based entry encoding)."""
+    dc = LocalCollection("we|ird", shape=(2,), init=lambda k: np.zeros(2))
+    for k in np.arange(3):  # np.int64 keys
+        dc.data_of(k).newest_copy().payload[:] = float(k) + 0.5
+    path = str(tmp_path / "npk")
+    save(path, dc)
+    dc2 = LocalCollection("we|ird", shape=(2,), init=lambda k: np.zeros(2))
+    assert restore(path, dc2) == 3
+    for k in range(3):
+        np.testing.assert_allclose(dc2.data_of(k).newest_copy().payload, k + 0.5)
+
+
+def test_shard_rank_from_distributed_collection(tmp_path):
+    """A replicated LocalCollection listed first must not decide the
+    shard rank (every rank would write rank0 and clobber)."""
+    path = str(tmp_path / "mix")
+    for r in range(2):
+        rep = LocalCollection("rep", shape=(1,), init=lambda k: np.full(1, 7.0))
+        rep.data_of(0)
+        A = TwoDimBlockCyclic(8, 8, 4, 4, p=2, q=1, myrank=r, name="A")
+        for (i, j) in A.local_tiles():
+            A.data_of(i, j).newest_copy().payload[:] = 10 * i + j
+        save(path, rep, A)  # replicated first — rank must come from A
+    assert len(shards_of(path)) == 2
+    B = TwoDimBlockCyclic(8, 8, 4, 4, name="A")
+    assert restore(path, B) >= 4
+    for (i, j) in B.local_tiles():
+        np.testing.assert_allclose(B.data_of(i, j).newest_copy().payload, 10 * i + j)
