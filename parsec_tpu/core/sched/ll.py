@@ -39,6 +39,7 @@ class SchedLL(Scheduler):
             v = (i + d) % n
             with self._locks[v]:
                 if self._locals[v]:
+                    es.stats["steals"] += 1
                     return self._locals[v].pop()
         return None
 

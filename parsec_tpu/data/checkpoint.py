@@ -17,10 +17,15 @@ Model:
 * device-resident tiles are staged to host first (the newest version
   wins, wherever it lives).
 
-Format: one numpy ``.npz`` per rank (`name|key` entry naming) plus a JSON
-manifest; portable and inspectable.  For jax-pytree state (optimizer
-state, model params) alongside collections, use orbax directly — this
-module covers the runtime's tiled data.
+Format: one numpy ``.npz`` per rank — entry names are JSON objects
+``{"c": <collection name>, "k": [<key...>]}`` — plus a JSON manifest;
+portable and inspectable.  For jax-pytree state (optimizer state, model
+params) alongside collections, use orbax directly — this module covers
+the runtime's tiled data.
+
+Replicated collections (every rank holds every tile; ``rank_of`` does not
+partition): pass ``owned_only=False`` (and an explicit ``rank=`` to
+``save``) so tiles are saved/restored regardless of the owner mapping.
 """
 
 from __future__ import annotations
@@ -32,11 +37,17 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 import numpy as np
 
 
-def _tile_items(dc) -> Iterable[Tuple[Any, np.ndarray]]:
-    """(key, host array) for every LOCAL tile holding data."""
+def _tile_items(dc, owned_only: bool = True) -> Iterable[Tuple[Any, np.ndarray]]:
+    """(key, host array) for local tiles holding data; ``owned_only``
+    filters to tiles this rank owns (False: every materialized tile —
+    the replicated-collection mode)."""
     from ..dsl.dtd import stage_to_cpu
 
-    if hasattr(dc, "local_tiles"):  # tiled matrices
+    if not owned_only and hasattr(dc, "keys"):
+        keys = dc.keys()
+    elif not owned_only and hasattr(dc, "tiles"):
+        keys = dc.tiles()
+    elif hasattr(dc, "local_tiles"):  # tiled matrices
         keys = dc.local_tiles()
     elif hasattr(dc, "keys"):
         keys = [k for k in dc.keys()
@@ -67,6 +78,7 @@ def _parse_entry(s: str) -> Tuple[str, Tuple]:
 
 
 def save(path: str, *collections, rank: Optional[int] = None,
+         owned_only: bool = True,
          meta: Optional[Dict[str, Any]] = None) -> str:
     """Persist every local tile of ``collections``; returns the shard
     path. Call at a quiescent point on every rank (same ``path``).
@@ -83,11 +95,15 @@ def save(path: str, *collections, rank: Optional[int] = None,
             if getattr(dc, "nodes", 1) > 1:
                 r = getattr(dc, "myrank", 0)
                 break
+    names = [dc.name for dc in collections]
+    if len(set(names)) != len(names):
+        # entries are keyed by collection name: a duplicate would silently
+        # clobber one collection's tiles with the other's
+        dupes = sorted({n for n in names if names.count(n) > 1})
+        raise ValueError(f"duplicate collection names in checkpoint: {dupes}")
     arrays: Dict[str, np.ndarray] = {}
-    names = []
     for dc in collections:
-        names.append(dc.name)
-        for key, arr in _tile_items(dc):
+        for key, arr in _tile_items(dc, owned_only=owned_only):
             arrays[_entry(dc.name, key)] = arr
     shard = f"{path}.rank{r}.npz"
     os.makedirs(os.path.dirname(os.path.abspath(shard)), exist_ok=True)
@@ -113,7 +129,8 @@ def shards_of(path: str) -> List[str]:
     return out
 
 
-def restore(path: str, *collections, all_shards: bool = True) -> int:
+def restore(path: str, *collections, all_shards: bool = True,
+            owned_only: bool = True) -> int:
     """Load tiles back into matching collections (by name + key).
 
     Reads every rank shard by default — each rank keeps only the tiles it
@@ -131,7 +148,7 @@ def restore(path: str, *collections, all_shards: bool = True) -> int:
                 dc = by_name.get(name)
                 if dc is None:
                     continue
-                if dc.rank_of(*key) != dc.myrank:
+                if owned_only and dc.rank_of(*key) != dc.myrank:
                     continue
                 arr = z[entry]
                 d = dc.data_of(*key)

@@ -47,6 +47,11 @@ def _build() -> Optional[str]:
     """Compile the shared library if missing/stale; returns its path or
     None (recording the failure for diagnostics)."""
     global _build_error
+    if os.environ.get("PARSEC_TPU_NATIVE_DISABLE"):
+        # CI fallback-path leg / debugging: pretend no toolchain exists so
+        # every consumer exercises its pure-Python path
+        _build_error = "disabled via PARSEC_TPU_NATIVE_DISABLE"
+        return None
     srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
     if not all(os.path.exists(s) for s in srcs):
         _build_error = f"sources missing under {_SRC_DIR}"

@@ -40,12 +40,15 @@ def read_samples(path: str) -> List[Dict[str, Any]]:
     return out
 
 
-def render(samples: List[Dict[str, Any]]) -> str:
-    """Latest values plus rates over the sampling window."""
+def render(samples: List[Dict[str, Any]], total: Optional[int] = None) -> str:
+    """Latest values plus rates over the sampling window; ``total``
+    overrides the displayed sample count (follow mode keeps only a
+    2-sample window but tracks the running total)."""
     if not samples:
         return "(no samples)"
     last = samples[-1]
-    lines = [f"sample @ t={last.get('t', 0):.3f} ({len(samples)} samples)"]
+    n = total if total is not None else len(samples)
+    lines = [f"sample @ t={last.get('t', 0):.3f} ({n} samples)"]
     prev = samples[-2] if len(samples) > 1 else None
     dt = (last.get("t", 0) - prev.get("t", 0)) if prev else 0.0
     for key in sorted(last):
@@ -105,19 +108,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             count += 1
             if len(window) > 2:
                 window.pop(0)
-        print(render_window(window, count))
+        print(render(window, total=count))
         updates += 1
         if not args.follow or (args.max_updates and updates >= args.max_updates):
             return 0
         time.sleep(args.interval)
-
-
-def render_window(window: List[Dict[str, Any]], total: int) -> str:
-    """Render from the trailing one-or-two samples + a running total."""
-    if not window:
-        return "(no samples)"
-    out = render(window)
-    return out.replace(f"({len(window)} samples)", f"({total} samples)")
 
 
 if __name__ == "__main__":

@@ -157,3 +157,48 @@ def test_reshape_unknown_type_name_is_wire_tag():
     """[type=NAME] with no registered constant is a comm-layout tag, not a
     local reshape — from_props must ignore it."""
     assert ReshapeSpec.from_props({"type": "DEFAULT"}, {}) is None
+
+
+def test_remote_read_reshape_multirank():
+    """Reshape on reception: the consumer rank receives the producer's
+    payload over the comm engine and its dep [dtype=...] converts it at
+    prepare_input — the reference remote_read_reshape.jdf case. The
+    producer's home tile keeps its own dtype (no re-reshape upstream,
+    remote_no_re_reshape.jdf)."""
+    import threading
+
+    from tests.runtime.test_multirank import run_ranks
+
+    seen = {}
+    lock = threading.Lock()
+    homes = {}
+
+    def build(rank, ctx):
+        dc = LocalCollection("D", shape=(4,), nodes=2, myrank=rank,
+                             init=lambda k: np.arange(4, dtype=np.float64))
+        dc.rank_of = lambda *key: (key[0] if key else 0) % 2
+        homes[rank] = dc
+
+        ptg = PTG("rreshape")
+        prod = ptg.task_class("prod")
+        prod.affinity("D(0)")  # rank 0
+        prod.flow("X", INOUT, "<- D(0)", "-> X cons()")
+        prod.body(cpu=lambda X: X.__iadd__(1.0))
+
+        cons = ptg.task_class("cons")
+        cons.affinity("D(1)")  # rank 1
+        cons.flow("X", IN, "<- X prod() [dtype=float32]")
+
+        def cbody(X):
+            with lock:
+                seen["dtype"] = X.dtype
+                seen["val"] = np.array(X)
+
+        cons.body(cpu=cbody)
+        return ptg.taskpool(D=dc)
+
+    run_ranks(2, build)
+    assert seen["dtype"] == np.float32
+    np.testing.assert_allclose(seen["val"], np.arange(4) + 1.0)
+    # producer home tile untouched by the consumer-side conversion
+    assert homes[0].data_of(0).newest_copy().payload.dtype == np.float64

@@ -97,3 +97,23 @@ def test_monitor_with_live_aggregator(tmp_path):
     samples = read_samples(path)
     assert samples
     assert any("runtime.pending_tasks" in s for s in samples)
+
+
+def test_ll_scheduler_counts_steals(monkeypatch):
+    """Regression: the ll scheduler's victim-pop steal site must account
+    steals like lfq/lhq do."""
+    monkeypatch.setenv("PARSEC_MCA_mca_sched", "ll")
+    from parsec_tpu.utils.mca_param import params
+
+    params.reset()
+    ctx = Context(nb_cores=4)
+    try:
+        assert ctx.scheduler.mca_name == "ll"
+        mod = PrintSteals(ctx, auto=False)
+        tp = _fan_tp(64)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=60)
+        assert sum(r["steals"] for r in mod.snapshot()) > 0
+    finally:
+        ctx.fini()
+        params.reset()

@@ -140,3 +140,28 @@ def test_shard_rank_from_distributed_collection(tmp_path):
     assert restore(path, B) >= 4
     for (i, j) in B.local_tiles():
         np.testing.assert_allclose(B.data_of(i, j).newest_copy().payload, 10 * i + j)
+
+
+def test_replicated_collection_mode(tmp_path):
+    """nodes>1 with a non-partitioning rank_of (replica on every rank):
+    owned_only=False saves/restores regardless of the owner mapping."""
+    path = str(tmp_path / "rep")
+    for r in range(2):
+        rep = LocalCollection("rep", shape=(2,), nodes=2, myrank=r,
+                              init=lambda k: np.zeros(2))
+        rep.data_of(0).newest_copy().payload[:] = 5.0 + r
+        save(path, rep, rank=r, owned_only=False)
+    # rank 1 restores its own shard's replica state
+    rep2 = LocalCollection("rep", shape=(2,), nodes=2, myrank=1,
+                           init=lambda k: np.zeros(2))
+    assert restore(f"{path}.rank1.npz", rep2, all_shards=False,
+                   owned_only=False) == 1
+    np.testing.assert_allclose(rep2.data_of(0).newest_copy().payload, 6.0)
+
+
+def test_duplicate_collection_names_rejected(tmp_path):
+    a = LocalCollection("A", shape=(1,), init=lambda k: np.zeros(1))
+    b = LocalCollection("A", shape=(1,), init=lambda k: np.zeros(1))
+    a.data_of(0), b.data_of(0)
+    with pytest.raises(ValueError, match="duplicate collection names"):
+        save(str(tmp_path / "dup"), a, b)
