@@ -24,8 +24,9 @@ params) alongside collections, use orbax directly — this module covers
 the runtime's tiled data.
 
 Replicated collections (every rank holds every tile; ``rank_of`` does not
-partition): pass ``owned_only=False`` (and an explicit ``rank=`` to
-``save``) so tiles are saved/restored regardless of the owner mapping.
+partition): pass ``owned_only=False`` plus an explicit ``rank=`` to BOTH
+``save`` (shard naming) and ``restore`` (each rank reads its own shard —
+reading all shards would let an arbitrary replica win).
 """
 
 from __future__ import annotations
@@ -43,10 +44,17 @@ def _tile_items(dc, owned_only: bool = True) -> Iterable[Tuple[Any, np.ndarray]]
     the replicated-collection mode)."""
     from ..dsl.dtd import stage_to_cpu
 
-    if not owned_only and hasattr(dc, "keys"):
-        keys = dc.keys()
-    elif not owned_only and hasattr(dc, "tiles"):
-        keys = dc.tiles()
+    if not owned_only:
+        # replicated mode: only MATERIALIZED tiles — enumerating the
+        # global tile space would lazily fabricate init/zero payloads for
+        # tiles this rank never touched and persist them as real state
+        store = getattr(dc, "_store", None)
+        if store is not None:
+            keys = list(store.keys())
+        elif hasattr(dc, "keys"):
+            keys = dc.keys()
+        else:
+            raise TypeError(f"cannot enumerate materialized tiles of {dc!r}")
     elif hasattr(dc, "local_tiles"):  # tiled matrices
         keys = dc.local_tiles()
     elif hasattr(dc, "keys"):
@@ -130,15 +138,28 @@ def shards_of(path: str) -> List[str]:
 
 
 def restore(path: str, *collections, all_shards: bool = True,
-            owned_only: bool = True) -> int:
+            owned_only: bool = True, rank: Optional[int] = None) -> int:
     """Load tiles back into matching collections (by name + key).
 
     Reads every rank shard by default — each rank keeps only the tiles it
     owns under the CURRENT distribution, so restoring under a different
-    rank layout (elastic restart) works.  Returns tiles restored locally."""
+    rank layout (elastic restart) works.  Returns tiles restored locally.
+
+    Replicated mode (``owned_only=False``): every shard holds the same
+    keys, so reading all of them would let an arbitrary shard win — pass
+    ``rank=`` to read exactly that rank's shard (or point ``path`` at one
+    shard with ``all_shards=False``)."""
     by_name = {dc.name: dc for dc in collections}
     restored = 0
-    paths = shards_of(path) if all_shards else [path]
+    if not owned_only and all_shards:
+        if rank is None:
+            raise ValueError(
+                "restore(owned_only=False) needs rank= (or a single shard "
+                "via all_shards=False): with every shard holding the same "
+                "replicated keys, reading all would pick one arbitrarily")
+        paths = [f"{path}.rank{rank}.npz"]
+    else:
+        paths = shards_of(path) if all_shards else [path]
     if not paths:
         raise FileNotFoundError(f"no checkpoint shards match {path}.rank*.npz")
     for shard in paths:

@@ -21,6 +21,7 @@ ALL = [
     "ex07_raw_ctl.py",
     "ex08_tpu_graph.py",
     "ex09_jdf_graph.py",
+    "ex10_sequence_parallel.py",
     os.path.join("dtd", "dtd_helloworld.py"),
     os.path.join("dtd", "dtd_hello_arg.py"),
     os.path.join("dtd", "dtd_untied.py"),

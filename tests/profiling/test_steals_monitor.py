@@ -79,6 +79,8 @@ def test_monitor_with_live_aggregator(tmp_path):
     monitor reads them back."""
     from parsec_tpu.profiling import dictionary
 
+    import time
+
     path = str(tmp_path / "agg.jsonl")
     ctx = Context(nb_cores=2)
     try:
@@ -87,6 +89,11 @@ def test_monitor_with_live_aggregator(tmp_path):
         tp = _fan_tp(16)
         ctx.add_taskpool(tp)
         assert tp.wait(timeout=60)
+        # under a loaded suite the sampler thread may not have ticked yet:
+        # wait until at least one sample exists before stopping
+        deadline = time.time() + 10
+        while not agg.samples and time.time() < deadline:
+            time.sleep(0.02)
         agg.stop()
     finally:
         ctx.fini()

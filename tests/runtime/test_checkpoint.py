@@ -151,12 +151,14 @@ def test_replicated_collection_mode(tmp_path):
                               init=lambda k: np.zeros(2))
         rep.data_of(0).newest_copy().payload[:] = 5.0 + r
         save(path, rep, rank=r, owned_only=False)
-    # rank 1 restores its own shard's replica state
+    # rank 1 restores its OWN shard's replica state via rank=
     rep2 = LocalCollection("rep", shape=(2,), nodes=2, myrank=1,
                            init=lambda k: np.zeros(2))
-    assert restore(f"{path}.rank1.npz", rep2, all_shards=False,
-                   owned_only=False) == 1
+    assert restore(path, rep2, owned_only=False, rank=1) == 1
     np.testing.assert_allclose(rep2.data_of(0).newest_copy().payload, 6.0)
+    # replicated restore over all shards would pick a replica arbitrarily
+    with pytest.raises(ValueError, match="needs rank="):
+        restore(path, rep2, owned_only=False)
 
 
 def test_duplicate_collection_names_rejected(tmp_path):
