@@ -48,11 +48,12 @@ def _tile_items(dc, owned_only: bool = True) -> Iterable[Tuple[Any, np.ndarray]]
         # replicated mode: only MATERIALIZED tiles — enumerating the
         # global tile space would lazily fabricate init/zero payloads for
         # tiles this rank never touched and persist them as real state
-        store = getattr(dc, "_store", None)
-        if store is not None:
-            keys = list(store.keys())
+        if hasattr(dc, "materialized_keys"):
+            keys = dc.materialized_keys()
         elif hasattr(dc, "keys"):
             keys = dc.keys()
+        elif hasattr(dc, "tiles"):
+            keys = dc.tiles()
         else:
             raise TypeError(f"cannot enumerate materialized tiles of {dc!r}")
     elif hasattr(dc, "local_tiles"):  # tiled matrices

@@ -104,6 +104,11 @@ class TiledMatrix(DataCollection):
                 self._store[k] = d
             return d
 
+    def materialized_keys(self):
+        """Tile keys whose Data exists right now (no lazy creation)."""
+        with self._lock:
+            return list(self._store)
+
     # -- whole-matrix helpers (tests / verification) ----------------------
     def to_array(self) -> np.ndarray:
         """Gather the local tiles into a dense array (single-rank use)."""

@@ -21,6 +21,12 @@ if TYPE_CHECKING:  # pragma: no cover
     from ..core.task import Task
 
 
+# data_advise advice values (reference device.h:76-78)
+ADVICE_PREFETCH = 0x01
+ADVICE_PREFERRED_DEVICE = 0x02
+ADVICE_WARMUP = 0x03
+
+
 class Device(Component):
     """Base device module (reference device vtable, ``device.h:142-158``)."""
 
@@ -60,6 +66,14 @@ class Device(Component):
 
     def memory_unregister(self, data) -> None:
         pass
+
+    def data_advise(self, data, advice: int) -> None:
+        """Placement hints (reference ``device.h:76-78,328``): PREFETCH
+        stages a copy here ahead of use, PREFERRED_DEVICE pins the
+        selector's choice, WARMUP marks the copy recently used.
+        Accelerator modules extend; the base handles PREFERRED_DEVICE."""
+        if advice == ADVICE_PREFERRED_DEVICE:
+            data.preferred_device = self.index
 
     def time_estimate(self, task: "Task") -> float:
         """Seconds this task would take here (lower = better)."""
@@ -142,6 +156,18 @@ def detach_devices(context: "Context") -> None:
             debug.warning("device %s detach failed: %s", dev.name, e)
 
 
+def _prefers_device(task: "Task", dev: Device) -> bool:
+    args = task.body_args
+    if not isinstance(args, (list, tuple)):
+        return False
+    for spec in args:
+        if (isinstance(spec, (list, tuple)) and len(spec) >= 2
+                and spec[0] == "data" and spec[1] is not None
+                and getattr(spec[1], "preferred_device", -1) == dev.index):
+            return True
+    return False
+
+
 def select_best_device(context: "Context", task: "Task") -> HookReturn:
     """Pick (device, chore) for a ready task; reference ``device.c:92-266``.
 
@@ -169,15 +195,23 @@ def select_best_device(context: "Context", task: "Task") -> HookReturn:
     if not eligible:
         return HookReturn.NEXT
 
-    # 1. affinity
+    # 0. explicit preference (data_advise PREFERRED_DEVICE) on any input;
+    # body_args may be an opaque payload for internal tasks (DTD comm
+    # tasks carry raw tuples) — only ("data", Data, mode) specs count
     best = None
-    best_bytes = 0
     for dev, chore, ci in eligible:
-        if dev.device_type == DEV_CPU:
-            continue
-        rb = dev.resident_data(task)
-        if rb > best_bytes:
-            best, best_bytes = (dev, chore, ci), rb
+        if _prefers_device(task, dev):
+            best = (dev, chore, ci)
+            break
+    # 1. affinity
+    best_bytes = 0
+    if best is None:
+        for dev, chore, ci in eligible:
+            if dev.device_type == DEV_CPU:
+                continue
+            rb = dev.resident_data(task)
+            if rb > best_bytes:
+                best, best_bytes = (dev, chore, ci), rb
     # 2. ETA
     if best is None:
         best_eta = None

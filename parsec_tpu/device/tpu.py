@@ -452,6 +452,35 @@ class TpuDevice(Device):
             self._reserve(0)
 
     # ------------------------------------------------------------------
+    def data_advise(self, data: Data, advice: int) -> None:
+        """Reference device.h:76-78: PREFETCH stages the newest version
+        into HBM ahead of first use (charged as a normal stage-in, LRU
+        clean); WARMUP re-touches a resident copy so eviction passes it
+        over; PREFERRED_DEVICE pins the selector (base class)."""
+        from .device import ADVICE_PREFETCH, ADVICE_WARMUP
+
+        if advice in (ADVICE_PREFETCH, ADVICE_WARMUP):
+            # residency (LRU/HBM accounting) is otherwise mutated only by
+            # the single active manager thread; holding _lock here keeps
+            # would-be managers out (kernel_scheduler's enqueue takes it),
+            # and an already-active manager means the device is busy — a
+            # hint may simply be dropped then (tiles stage on demand)
+            with self._lock:
+                if self._manager_active:
+                    return
+                if advice == ADVICE_PREFETCH:
+                    if data.newest_copy() is None:
+                        return  # nothing materialized yet: hint, not a command
+                    self._stage_in(data)
+                else:
+                    mine = data.get_copy(self.data_index)
+                    if mine is not None and mine.payload is not None:
+                        self._lru_touch(
+                            data, dirty=mine.coherency is Coherency.OWNED)
+        else:
+            super().data_advise(data, advice)
+
+    # ------------------------------------------------------------------
     def resident_data(self, task: Task) -> int:
         total = 0
         for spec in task.body_args or ():

@@ -218,3 +218,39 @@ def test_detach_flushes_dirty_tiles():
     assert host is not None
     np.testing.assert_allclose(np.asarray(host.payload), 5.0)
     assert host.version == d.newest_copy().version
+
+
+def test_data_advise_prefetch_and_warmup(ctx):
+    """Reference device.h data_advise: PREFETCH stages ahead of use (the
+    task then sees zero stage-in bytes), WARMUP re-touches the LRU."""
+    from parsec_tpu.device.device import ADVICE_PREFETCH, ADVICE_WARMUP
+
+    dev = tpu_dev(ctx)
+    d = data_create("adv", payload=np.full((16, 16), 2.0))
+    dev.data_advise(d, ADVICE_PREFETCH)
+    staged = dev.stats["bytes_in"]
+    assert staged == 16 * 16 * 8  # prefetch did the H2D
+    tp = DTDTaskpool(ctx)
+    tp.insert_task({DEV_TPU: lambda x: x + 1.0}, (d, INOUT))
+    assert tp.wait(timeout=60)
+    assert dev.stats["bytes_in"] == staged  # no second transfer
+    dev.data_advise(d, ADVICE_WARMUP)  # resident: must not raise
+
+
+def test_data_advise_preferred_device(ctx):
+    """PREFERRED_DEVICE pins selection even when the ETA would pick the
+    other device."""
+    from parsec_tpu.device.device import ADVICE_PREFERRED_DEVICE
+
+    dev = tpu_dev(ctx)
+    d = data_create("pref", payload=np.ones(4))
+    dev.data_advise(d, ADVICE_PREFERRED_DEVICE)
+    assert d.preferred_device == dev.index
+    ran_on = []
+    tp = DTDTaskpool(ctx)
+    # both incarnations available: preference must force the TPU one
+    tp.insert_task({DEV_CPU: lambda x: ran_on.append("cpu"),
+                    DEV_TPU: lambda x: (ran_on.append("tpu"), x + 0.0)[1]},
+                   (d, INOUT))
+    assert tp.wait(timeout=60)
+    assert ran_on == ["tpu"]
