@@ -112,6 +112,7 @@ class Context:
         self._started = False
         self._shutdown = False
         self._fini_cbs = []
+        self._abort_reason = None
         self._tls = threading.local()
 
         self._threads: List[threading.Thread] = []
@@ -155,6 +156,32 @@ class Context:
             if tp.taskpool_id in self._taskpools:
                 del self._taskpools[tp.taskpool_id]
                 self._active_taskpools -= 1
+            self._cv.notify_all()
+
+    def abort(self, reason: str = "") -> None:
+        """Cancel all outstanding work (reference ``parsec_abort``,
+        ``runtime.h:236`` — softened: the process survives).  Every
+        active taskpool terminates as FAILED (its ``wait()`` returns
+        False), waiters wake immediately, and the context stays usable
+        for new taskpools.  Already-queued tasks of aborted pools are
+        discarded lazily at selection time (``_next_task``) — the
+        scheduler structures are never reset here, because workers may be
+        inside ``select()`` concurrently.  The last abort reason stays
+        readable as ``ctx._abort_reason``."""
+        with self._cv:
+            self._abort_reason = reason or "aborted"
+            pools = list(self._taskpools.values())
+        debug.warning("context abort: %s (%d active taskpools)",
+                      self._abort_reason, len(pools))
+        for tp in pools:
+            tp.failed = True
+            # sets _terminated first, so a late in-flight completion that
+            # drives the tdm counter to zero finds the pool already
+            # terminated and does NOT fire on_complete (idempotence guard
+            # in Taskpool._termination_detected)
+            tp._terminated.set()
+            self._taskpool_terminated(tp)
+        with self._cv:
             self._cv.notify_all()
 
     # ------------------------------------------------------------------
@@ -223,12 +250,20 @@ class Context:
         task = es.next_task
         if task is not None:
             es.next_task = None
-            return task
+            if not task.taskpool.failed:
+                return task
+            # the kept-next fast path must honor an abort too — an
+            # in-flight predecessor may have stashed a successor of the
+            # cancelled DAG here after abort() ran
         from ..profiling import pins
 
         pins.fire(pins.SELECT_BEGIN, es, None)
         task = self.scheduler.select(es)
         pins.fire(pins.SELECT_END, es, task)
+        # a task of an aborted pool may linger in a queue (its release was
+        # in flight during the abort's scheduler reset): discard, don't run
+        while task is not None and task.taskpool.failed:
+            task = self.scheduler.select(es)
         if task is not None:
             es.stats["selected"] += 1
         return task

@@ -47,6 +47,8 @@ class Taskpool:
         self.tdm: TermDetMonitor = open_component("termdet", termdet)
         self.tdm.monitor_taskpool(self, self._termination_detected)
         self._terminated = threading.Event()
+        #: set by Context.abort(): quiesced by cancellation, not success
+        self.failed = False
         self.on_enqueue: Optional[Callable[["Taskpool"], None]] = None
         self.on_complete: Optional[Callable[["Taskpool"], None]] = None
         #: front-end startup hook: enumerate initially-ready tasks
@@ -85,6 +87,11 @@ class Taskpool:
         return []
 
     def _termination_detected(self, tp: "Taskpool") -> None:
+        if self._terminated.is_set():
+            # already terminated (normally, or force-failed by
+            # Context.abort): a late tdm zero-crossing from an in-flight
+            # task must not re-fire on_complete
+            return
         debug.verbose(4, "core", "taskpool %s(%d) terminated", self.name, self.taskpool_id)
         self._terminated.set()
         if self.context is not None:
@@ -101,10 +108,13 @@ class Taskpool:
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block the caller until this taskpool quiesces
-        (reference ``parsec_taskpool_wait``, ``scheduling.c:995``)."""
+        (reference ``parsec_taskpool_wait``, ``scheduling.c:995``).
+        Returns False on timeout or when the pool was aborted."""
         if self.context is not None:
-            return self.context.wait_taskpool(self, timeout=timeout)
-        return self._terminated.wait(timeout)
+            ok = self.context.wait_taskpool(self, timeout=timeout)
+        else:
+            ok = self._terminated.wait(timeout)
+        return ok and not self.failed
 
     # -- helpers ----------------------------------------------------------
     def new_task(self, tc: TaskClass, locals_=(), priority: int = 0) -> Task:

@@ -141,3 +141,66 @@ def test_device_load_balanced_after_again():
         cpu = ctx.devices[0]
         assert cpu.device_load == pytest.approx(0.0)
         assert cpu.stats["executed_tasks"] == 1
+
+
+def test_context_abort_cancels_pending_work():
+    """Reference parsec_abort (runtime.h:236), softened: abort discards
+    queued tasks, aborted pools' wait() returns False immediately, and
+    the context remains usable for new taskpools."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from parsec_tpu.data import LocalCollection
+    from parsec_tpu.dsl.ptg import PTG, INOUT
+
+    ran = []
+    release = threading.Event()
+
+    def slow_body(X, k):
+        if k == 0:
+            release.wait(10)  # hold the chain so successors stay pending
+        ran.append(k)
+
+    dc = LocalCollection("D", shape=(1,), init=lambda k: np.zeros(1))
+    ptg = PTG("abortable")
+    step = ptg.task_class("step", k="0 .. N-1")
+    step.affinity("D(0)")
+    step.flow("X", INOUT,
+              "<- (k == 0) ? D(0) : X step(k-1)",
+              "-> (k < N-1) ? X step(k+1) : D(0)")
+    step.body(cpu=slow_body)
+
+    ctx = Context(nb_cores=2)
+    try:
+        tp = ptg.taskpool(N=50, D=dc)
+        ctx.add_taskpool(tp)
+        time.sleep(0.1)  # task 0 is now blocking the chain
+        t0 = time.time()
+        ctx.abort("test cancellation")
+        assert tp.wait(timeout=5) is False  # aborted, not successful
+        assert time.time() - t0 < 5  # returned promptly, no timeout
+        assert tp.failed
+        release.set()
+        time.sleep(0.2)  # let the in-flight task 0 drain
+        assert len(ran) <= 1  # at most the in-flight task; chain cancelled
+
+        # the context is still usable for new work
+        done = []
+        ptg2 = PTG("after")
+        a = ptg2.task_class("a", k="0 .. 3")
+        a.affinity("D(0)")
+        a.flow("X", INOUT, "<- D(0)", "-> D(0)")
+        a.body(cpu=lambda X, k: done.append(k))
+        tp2 = ptg2.taskpool(D=dc)
+        ctx.add_taskpool(tp2)
+        assert tp2.wait(timeout=30)
+        assert sorted(done) == [0, 1, 2, 3]
+        # waking the workers for tp2 must NOT resurrect the cancelled
+        # chain via the kept-next-task fast path
+        time.sleep(0.1)
+        assert len(ran) <= 1, ran
+    finally:
+        release.set()
+        ctx.fini()
