@@ -174,13 +174,11 @@ class Context:
         debug.warning("context abort: %s (%d active taskpools)",
                       self._abort_reason, len(pools))
         for tp in pools:
-            tp.failed = True
-            # sets _terminated first, so a late in-flight completion that
-            # drives the tdm counter to zero finds the pool already
-            # terminated and does NOT fire on_complete (idempotence guard
-            # in Taskpool._termination_detected)
-            tp._terminated.set()
-            self._taskpool_terminated(tp)
+            # atomic against a concurrent normal termination (the pool's
+            # _term_lock): whichever side wins, on_complete fires at most
+            # once and never after a successful cancellation
+            if tp._force_fail():
+                self._taskpool_terminated(tp)
         with self._cv:
             self._cv.notify_all()
 

@@ -47,6 +47,8 @@ class Taskpool:
         self.tdm: TermDetMonitor = open_component("termdet", termdet)
         self.tdm.monitor_taskpool(self, self._termination_detected)
         self._terminated = threading.Event()
+        #: serializes normal termination against Context.abort's force-fail
+        self._term_lock = threading.Lock()
         #: set by Context.abort(): quiesced by cancellation, not success
         self.failed = False
         self.on_enqueue: Optional[Callable[["Taskpool"], None]] = None
@@ -86,14 +88,27 @@ class Taskpool:
             return list(self.startup_hook(context, self))
         return []
 
+    def _force_fail(self) -> bool:
+        """Context.abort(): mark cancelled unless already terminated
+        normally. The lock makes this atomic against a concurrent
+        _termination_detected, so on_complete can never fire after a
+        successful force-fail."""
+        with self._term_lock:
+            if self._terminated.is_set():
+                return False
+            self.failed = True
+            self._terminated.set()
+            return True
+
     def _termination_detected(self, tp: "Taskpool") -> None:
-        if self._terminated.is_set():
-            # already terminated (normally, or force-failed by
-            # Context.abort): a late tdm zero-crossing from an in-flight
-            # task must not re-fire on_complete
-            return
+        with self._term_lock:
+            if self._terminated.is_set():
+                # already terminated (normally, or force-failed by
+                # Context.abort): a late tdm zero-crossing must not
+                # re-fire on_complete / resume a cancelled composition
+                return
+            self._terminated.set()
         debug.verbose(4, "core", "taskpool %s(%d) terminated", self.name, self.taskpool_id)
-        self._terminated.set()
         if self.context is not None:
             self.context._taskpool_terminated(self)
         if self.on_complete is not None:

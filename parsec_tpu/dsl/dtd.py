@@ -741,6 +741,8 @@ class DTDTaskpool(Taskpool):
             return
         self.context.start()
         while True:
+            if self.failed:
+                return  # aborted: the backlog will never drain
             with self._quiesce:
                 if self._inserted - self._retired <= self.threshold:
                     return
@@ -763,6 +765,8 @@ class DTDTaskpool(Taskpool):
 
         deadline = (time.monotonic() + timeout) if timeout is not None else None
         while True:
+            if self.failed:
+                return False  # Context.abort(): discarded tasks never retire
             with self._quiesce:
                 if self._retired >= self._inserted:
                     return True

@@ -65,12 +65,17 @@ def cmd_info(args) -> int:
     per: Dict[str, List[float]] = defaultdict(list)
     for s in spans:
         per[s["name"]].append(s["dur_us"])
-    print(f"{'event class':<24}{'count':>8}{'total_ms':>12}{'avg_us':>10}")
+    print(f"{'event class':<24}{'count':>8}{'total_ms':>12}{'avg_us':>10}"
+          f"{'p50_us':>10}{'p95_us':>10}{'max_us':>10}")
     for name in sorted(per):
-        durs = per[name]
+        durs = sorted(per[name])
         total = sum(durs)
-        print(f"{name:<24}{len(durs):>8}{total/1e3:>12.3f}"
-              f"{total/len(durs):>10.1f}")
+        n = len(durs)
+        # nearest-rank percentiles: index ceil(q*n) - 1
+        p50 = durs[max(0, -(-n * 50 // 100) - 1)]
+        p95 = durs[max(0, -(-n * 95 // 100) - 1)]
+        print(f"{name:<24}{n:>8}{total/1e3:>12.3f}{total/n:>10.1f}"
+              f"{p50:>10.1f}{p95:>10.1f}{durs[-1]:>10.1f}")
     return 0
 
 

@@ -204,3 +204,33 @@ def test_context_abort_cancels_pending_work():
     finally:
         release.set()
         ctx.fini()
+
+
+def test_abort_unblocks_dtd_wait():
+    """DTD overrides wait() with a retired-vs-inserted poll: abort must
+    make it return False instead of spinning forever on discarded tasks."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from parsec_tpu.data import data_create
+    from parsec_tpu.dsl import DTDTaskpool, INOUT
+
+    gate = threading.Event()
+    d = data_create("x", payload=np.zeros(1))
+    ctx = Context(nb_cores=2)
+    try:
+        tp = DTDTaskpool(ctx)
+        tp.insert_task(lambda x: gate.wait(10), (d, INOUT))
+        for _ in range(20):
+            tp.insert_task(lambda x: None, (d, INOUT))
+        time.sleep(0.1)
+        ctx.abort("cancel dtd")
+        t0 = time.time()
+        assert tp.wait(timeout=5) is False
+        assert time.time() - t0 < 2  # prompt, not the timeout
+        assert tp.failed
+    finally:
+        gate.set()
+        ctx.fini()
