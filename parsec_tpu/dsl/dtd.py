@@ -342,6 +342,10 @@ class DTDTaskpool(Taskpool):
         """
         if not self._open:
             raise RuntimeError("taskpool closed for insertion")
+        if self.failed:
+            raise RuntimeError(
+                "taskpool was aborted; tasks inserted now would be "
+                "silently discarded")
         if self.context is None:
             raise RuntimeError("DTD taskpool must be attached to a context before insertion")
         bodies = body if isinstance(body, dict) else {DEV_CPU: body}

@@ -234,3 +234,22 @@ def test_abort_unblocks_dtd_wait():
     finally:
         gate.set()
         ctx.fini()
+
+
+def test_insert_into_aborted_dtd_pool_rejected():
+    import numpy as np
+
+    from parsec_tpu.data import data_create
+    from parsec_tpu.dsl import DTDTaskpool, INOUT
+
+    d = data_create("y", payload=np.zeros(1))
+    ctx = Context(nb_cores=1)
+    try:
+        tp = DTDTaskpool(ctx)
+        tp.insert_task(lambda x: None, (d, INOUT))
+        assert tp.wait(timeout=30)
+        ctx.abort("stop")
+        with pytest.raises(RuntimeError, match="aborted"):
+            tp.insert_task(lambda x: None, (d, INOUT))
+    finally:
+        ctx.fini()
