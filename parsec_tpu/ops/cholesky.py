@@ -27,17 +27,25 @@ IN = AccessMode.IN
 INOUT = AccessMode.INOUT
 
 
-def cholesky_ptg(*, use_tpu: bool = True, use_cpu: bool = True) -> PTG:
+def cholesky_ptg(*, use_tpu: bool = True, use_cpu: bool = True,
+                 use_pallas: bool = False) -> PTG:
     """Build the dpotrf PTG (instantiate with ``.taskpool(NT=..., A=...)``
     where ``A`` is a TiledMatrix holding the SPD matrix; the factorization
-    happens in place, lower-triangular)."""
+    happens in place, lower-triangular).
+
+    ``use_pallas`` swaps the syrk/gemm update TPU chores for the fused
+    Pallas MXU kernels (:mod:`parsec_tpu.ops.pallas_kernels`) — the
+    TPU-native analogue of the reference's hand-written CUDA BODYs
+    (``tests/runtime/cuda/nvlink.jdf:136-155``)."""
     ptg = PTG("dpotrf")
 
     def bodies(cpu, tpu):
         kw = {}
         if use_cpu:
             kw["cpu"] = cpu
-        if use_tpu:
+        if use_tpu or use_pallas:
+            # a pallas chore is a device chore: requesting it implies the
+            # device incarnation even when use_tpu wasn't set explicitly
             kw["tpu"] = tpu
         return kw
 
@@ -71,7 +79,8 @@ def cholesky_ptg(*, use_tpu: bool = True, use_cpu: bool = True) -> PTG:
               "-> (k == m-1) ? T potrf(m) : A syrk(k+1, m)")
     syrk.flow("B", IN,
               "<- C trsm(k, m)")
-    syrk.body(**bodies(tiles.syrk_cpu, tiles.syrk_tpu))
+    syrk.body(**bodies(tiles.syrk_cpu,
+                       tiles.syrk_pallas if use_pallas else tiles.syrk_tpu))
 
     gemm = ptg.task_class("gemm", k="0 .. NT-3", m="k+2 .. NT-1", n="k+1 .. m-1")
     gemm.affinity("A(m, n)")
@@ -81,7 +90,9 @@ def cholesky_ptg(*, use_tpu: bool = True, use_cpu: bool = True) -> PTG:
               "-> (k == n-1) ? C trsm(n, m) : A gemm(k+1, m, n)")
     gemm.flow("B1", IN, "<- C trsm(k, m)")
     gemm.flow("B2", IN, "<- C trsm(k, n)")
-    gemm.body(**bodies(tiles.gemm_update_cpu, tiles.gemm_update_tpu))
+    gemm.body(**bodies(tiles.gemm_update_cpu,
+                       tiles.gemm_update_pallas if use_pallas
+                       else tiles.gemm_update_tpu))
 
     return ptg
 

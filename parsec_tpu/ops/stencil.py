@@ -131,7 +131,21 @@ def stencil_tpu(OLD, UP, DOWN, LEFT, RIGHT, NEW, **_):
     return _apply_5pt(jnp, OLD, UP, DOWN, LEFT, RIGHT)
 
 
-def stencil_ptg(*, use_tpu: bool = False) -> PTG:
+def stencil_pallas(OLD, UP, DOWN, LEFT, RIGHT, NEW, **_):
+    """Pallas chore: the 5-point step as one VMEM-resident kernel
+    (:func:`parsec_tpu.ops.pallas_kernels.stencil_5pt`); halo tiles are
+    reduced to their facing edge rows/columns before the call."""
+    from .pallas_kernels import stencil_5pt
+
+    h, w = OLD.shape
+    up = jnp.zeros((1, w), OLD.dtype) if UP is None else UP[-1:, :]
+    down = jnp.zeros((1, w), OLD.dtype) if DOWN is None else DOWN[:1, :]
+    left = jnp.zeros((h, 1), OLD.dtype) if LEFT is None else LEFT[:, -1:]
+    right = jnp.zeros((h, 1), OLD.dtype) if RIGHT is None else RIGHT[:, :1]
+    return stencil_5pt(OLD, up, down, left, right)
+
+
+def stencil_ptg(*, use_tpu: bool = False, use_pallas: bool = False) -> PTG:
     """Build the 2D 5-point stencil PTG; instantiate with
     ``taskpool(T=iters, MT=..., NT=..., A=StencilBuffers(...))``."""
     ptg = PTG("stencil2d")
@@ -171,7 +185,9 @@ def stencil_ptg(*, use_tpu: bool = False) -> PTG:
             "-> (t < T-1 and j > 0) ? RIGHT stencil(t+1, i, j-1)",
             "-> (t < T-1 and j < NT-1) ? LEFT stencil(t+1, i, j+1)",
             "-> A((t+1) % 2, i, j)")
-    kw = {"tpu": stencil_tpu} if use_tpu else {}
+    kw = {}
+    if use_tpu or use_pallas:
+        kw["tpu"] = stencil_pallas if use_pallas else stencil_tpu
     st.body(cpu=stencil_cpu, **kw)
     return ptg
 

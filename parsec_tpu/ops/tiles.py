@@ -69,3 +69,21 @@ def gemm_update_cpu(A, B1, B2, **_):
 
 def gemm_update_tpu(A, B1, B2, **_):
     return A - jnp.dot(B1, B2.T, precision="highest")
+
+
+# -- Pallas incarnations ----------------------------------------------------
+# The update kernels (where the dpotrf FLOPs are) as fused Pallas MXU
+# kernels: the subtraction rides the accumulation loop, one HBM write of
+# the tile instead of product + subtract. Same BODY signature as the
+# ``*_tpu`` chores; the device module jit-dispatches them identically.
+
+def syrk_pallas(A, B, **_):
+    from .pallas_kernels import matmul_update
+
+    return matmul_update(A, B, B, alpha=-1.0)
+
+
+def gemm_update_pallas(A, B1, B2, **_):
+    from .pallas_kernels import matmul_update
+
+    return matmul_update(A, B1, B2, alpha=-1.0)

@@ -47,3 +47,15 @@ def test_stencil_single_tile(ctx):
     ctx.add_taskpool(tp)
     assert tp.wait(timeout=30)
     np.testing.assert_allclose(A.to_array(0), reference_stencil(grid, 2), rtol=1e-12)
+
+
+def test_stencil_pallas_bodies(ctx):
+    """Pallas chore (interpret off-TPU): same numerics as the jnp body."""
+    rng = np.random.default_rng(2)
+    grid = rng.standard_normal((16, 24)).astype(np.float32)
+    A = StencilBuffers(grid, 2, 2)
+    tp = stencil_ptg(use_pallas=True).taskpool(T=3, MT=2, NT=2, A=A)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=120)
+    np.testing.assert_allclose(
+        A.to_array(3 % 2), reference_stencil(grid, 3), rtol=1e-5, atol=1e-5)

@@ -80,3 +80,18 @@ def test_lowered_requires_functional_body():
     s.body(cpu=lambda X: X.__iadd__(1))
     with pytest.raises(ValueError, match="functional"):
         GraphExecutor(ptg.taskpool(D=dc))
+
+
+def test_lowered_cholesky_pallas_chores():
+    """dpotrf with the fused Pallas update kernels (interpret off-TPU)
+    through the whole-DAG capture path."""
+    n, nb = 128, 32
+    A = TiledMatrix(n, n, nb, nb, name="A", dtype=np.float32)
+    S = _spd(n, dtype=np.float32, seed=3)
+    A.from_array(S)
+    tp = cholesky_ptg(use_tpu=True, use_cpu=False,
+                      use_pallas=True).taskpool(NT=A.mt, A=A)
+    ex = GraphExecutor(tp)
+    ex(block=True)
+    L = np.tril(A.to_array())
+    np.testing.assert_allclose(L @ L.T, S, rtol=2e-3, atol=2e-3)

@@ -1,0 +1,149 @@
+"""Pallas kernel numerics vs dense references (interpret mode on CPU).
+
+The kernels are the hot BODYs (dpotrf updates, stencil step, ring
+attention block); each is checked elementwise against the plain jnp
+formulation it replaces.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from parsec_tpu.ops import pallas_kernels as pk  # noqa: E402
+
+
+def test_matmul_update_syrk_shape():
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((256, 256)).astype(np.float32)
+    B = rng.standard_normal((256, 128)).astype(np.float32)
+    out = np.asarray(pk.matmul_update(jnp.asarray(A), jnp.asarray(B),
+                                      jnp.asarray(B), alpha=-1.0))
+    np.testing.assert_allclose(out, A - B @ B.T, rtol=1e-5, atol=1e-5)
+
+
+def test_matmul_update_gemm_blocked():
+    rng = np.random.default_rng(1)
+    C = rng.standard_normal((256, 384)).astype(np.float32)
+    A = rng.standard_normal((256, 512)).astype(np.float32)
+    B = rng.standard_normal((384, 512)).astype(np.float32)
+    # force blocking: 256/128, 384/128, 512/128 grid
+    out = np.asarray(pk.matmul_update(jnp.asarray(C), jnp.asarray(A),
+                                      jnp.asarray(B), alpha=-1.0,
+                                      bm=128, bn=128, bk=128))
+    np.testing.assert_allclose(out, C - A @ B.T, rtol=1e-4, atol=1e-4)
+
+
+def test_matmul_update_no_transpose_positive_alpha():
+    rng = np.random.default_rng(2)
+    C = rng.standard_normal((128, 128)).astype(np.float32)
+    A = rng.standard_normal((128, 256)).astype(np.float32)
+    B = rng.standard_normal((256, 128)).astype(np.float32)
+    out = np.asarray(pk.matmul_update(jnp.asarray(C), jnp.asarray(A),
+                                      jnp.asarray(B), alpha=1.0,
+                                      transpose_b=False, bk=128))
+    np.testing.assert_allclose(out, C + A @ B, rtol=1e-4, atol=1e-4)
+
+
+def _pad_ref(old, up, down, left, right):
+    h, w = old.shape
+    pad = np.zeros((h + 2, w + 2), old.dtype)
+    pad[1:-1, 1:-1] = old
+    if up is not None:
+        pad[0, 1:-1] = up
+    if down is not None:
+        pad[-1, 1:-1] = down
+    if left is not None:
+        pad[1:-1, 0] = left
+    if right is not None:
+        pad[1:-1, -1] = right
+    return 0.25 * (pad[:-2, 1:-1] + pad[2:, 1:-1] + pad[1:-1, :-2] + pad[1:-1, 2:])
+
+
+def test_stencil_5pt_with_halos():
+    rng = np.random.default_rng(3)
+    old = rng.standard_normal((16, 128)).astype(np.float32)
+    up = rng.standard_normal((1, 128)).astype(np.float32)
+    down = rng.standard_normal((1, 128)).astype(np.float32)
+    left = rng.standard_normal((16, 1)).astype(np.float32)
+    right = rng.standard_normal((16, 1)).astype(np.float32)
+    out = np.asarray(pk.stencil_5pt(*map(jnp.asarray, (old, up, down, left, right))))
+    ref = _pad_ref(old, up[0], down[0], left[:, 0], right[:, 0])
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_stencil_5pt_fused_matches_iterated():
+    rng = np.random.default_rng(4)
+    g = rng.standard_normal((32, 128)).astype(np.float32)
+    out = np.asarray(pk.stencil_5pt_fused(jnp.asarray(g), 5))
+    ref = g.copy()
+    for _ in range(5):
+        ref = _pad_ref(ref, None, None, None, None)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_block_accumulates_to_dense(causal):
+    """Feeding all K/V blocks through the online update == dense softmax."""
+    rng = np.random.default_rng(5)
+    Sq, Sk, D, R = 128, 128, 64, 4
+    scale = 1.0 / np.sqrt(D)
+    q = rng.standard_normal((Sq, D)).astype(np.float32)
+    ks = [rng.standard_normal((Sk, D)).astype(np.float32) for _ in range(R)]
+    vs = [rng.standard_normal((Sk, D)).astype(np.float32) for _ in range(R)]
+
+    acc = jnp.zeros((Sq, D), jnp.float32)
+    m = jnp.full((Sq, 1), -1e30, jnp.float32)
+    l = jnp.zeros((Sq, 1), jnp.float32)
+    q_off = (R - 1) * Sk  # queries are the LAST block -> full causal visibility
+    for r in range(R):
+        acc, m, l = pk.flash_attention_block(
+            jnp.asarray(q), jnp.asarray(ks[r]), jnp.asarray(vs[r]),
+            acc, m, l, q_off, r * Sk, causal=causal, scale=float(scale))
+    out = np.asarray(acc / l)
+
+    K = np.concatenate(ks, 0)
+    V = np.concatenate(vs, 0)
+    logits = (q @ K.T) * scale
+    if causal:
+        qpos = q_off + np.arange(Sq)[:, None]
+        kpos = np.arange(R * Sk)[None, :]
+        logits = np.where(qpos >= kpos, logits, -np.inf)
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w /= w.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out, w @ V, rtol=1e-4, atol=1e-4)
+
+
+def test_flash_attention_block_causal_masks_future_block():
+    """A K/V block entirely in the future must not change the carry."""
+    rng = np.random.default_rng(6)
+    Sq, Sk, D = 128, 128, 32
+    q = jnp.asarray(rng.standard_normal((Sq, D)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((Sk, D)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((Sk, D)).astype(np.float32))
+    acc0 = jnp.asarray(rng.standard_normal((Sq, D)).astype(np.float32))
+    m0 = jnp.zeros((Sq, 1), jnp.float32)
+    l0 = jnp.ones((Sq, 1), jnp.float32)
+    acc, m, l = pk.flash_attention_block(
+        q, k, v, acc0, m0, l0, 0, Sk, causal=True, scale=0.1)
+    np.testing.assert_allclose(np.asarray(acc), np.asarray(acc0), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(l), np.asarray(l0), rtol=1e-6, atol=1e-6)
+
+
+def test_flash_attention_block_masked_block_at_init_carry():
+    """Regression: a fully-masked future block processed FIRST (carry still
+    at its -1e30/0/0 init) must leave the carry exactly unchanged."""
+    rng = np.random.default_rng(7)
+    Sq, Sk, D = 128, 128, 32
+    q = jnp.asarray(rng.standard_normal((Sq, D)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((Sk, D)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((Sk, D)).astype(np.float32))
+    acc = jnp.zeros((Sq, D), jnp.float32)
+    m = jnp.full((Sq, 1), -1e30, jnp.float32)
+    l = jnp.zeros((Sq, 1), jnp.float32)
+    acc2, m2, l2 = pk.flash_attention_block(
+        q, k, v, acc, m, l, 0, Sk, causal=True, scale=0.1)
+    assert float(jnp.abs(acc2).max()) == 0.0
+    assert float(jnp.abs(l2).max()) == 0.0
+    np.testing.assert_array_equal(np.asarray(m2), np.asarray(m))
