@@ -87,18 +87,33 @@ def main() -> None:
     from parsec_tpu.dsl.xla_lower import GraphExecutor
     from parsec_tpu.ops import cholesky_ptg
 
-    Ag = TiledMatrix(N, N, NB, NB, name="A", dtype=dtype).from_array(SPD)
-    tpg = cholesky_ptg(use_tpu=True, use_cpu=False).taskpool(NT=Ag.mt, A=Ag)
-    ex = GraphExecutor(tpg, donate=False)  # reusable feeds for repetitions
-    feeds = {k: jax.device_put(jnp.asarray(Ag.data_of(*k[1]).newest_copy().payload))
-             for k in ex.input_keys}
-    last_key = ex.output_keys[-1]
-    sync_scalar(ex.apply(feeds)[last_key])  # compile
-    t_graph = measure(lambda: ex.apply(feeds)[last_key], reps)
+    def graph_path(use_pallas):
+        """(per-run seconds, last-tile array) for the captured-DAG path."""
+        Am = TiledMatrix(N, N, NB, NB, name="A", dtype=dtype).from_array(SPD)
+        tp_ = cholesky_ptg(use_tpu=True, use_cpu=False,
+                           use_pallas=use_pallas).taskpool(NT=Am.mt, A=Am)
+        ex_ = GraphExecutor(tp_, donate=False)  # reusable feeds for reps
+        fd = {k: jax.device_put(
+            jnp.asarray(Am.data_of(*k[1]).newest_copy().payload))
+            for k in ex_.input_keys}
+        last = ex_.output_keys[-1]
+        sync_scalar(ex_.apply(fd)[last])  # compile
+        t = measure(lambda: ex_.apply(fd)[last], reps)
+        L = np.asarray(jax.device_get(ex_.apply(fd)[last]))
+        return t, L
+
+    t_graph, L_tile = graph_path(False)
+
+    # same DAG with the fused Pallas update chores (ops/pallas_kernels.py:
+    # syrk/gemm tile updates as grid-blocked MXU kernels with the
+    # subtraction fused into the accumulation loop)
+    t_graph_pallas = Lp = None
+    try:
+        t_graph_pallas, Lp = graph_path(True)
+    except Exception as e:  # pragma: no cover - pallas unavailable
+        print(f"pallas path skipped: {e}", file=sys.stderr)
 
     # numerics: captured result must match the monolithic factorization
-    out = ex.apply(feeds)
-    L_tile = np.asarray(jax.device_get(out[("A", (Ag.mt - 1, Ag.nt - 1))]))
     L_ref = np.asarray(jax.device_get(chol(A_dev)))
     h = L_tile.shape[0]
     err = np.max(np.abs(np.tril(L_tile) - np.tril(L_ref[-h:, -h:])))
@@ -106,6 +121,11 @@ def main() -> None:
     if not np.isfinite(err) or err / scale > 1e-3:
         print(json.dumps({"error": f"numerics mismatch: {err}"}))
         raise SystemExit(1)
+    if t_graph_pallas is not None:
+        errp = np.max(np.abs(np.tril(Lp) - np.tril(L_ref[-h:, -h:])))
+        if not np.isfinite(errp) or errp / scale > 1e-2:
+            print(f"pallas numerics off ({errp}), dropping", file=sys.stderr)
+            t_graph_pallas = None
 
     # ---- task runtime: dynamic scheduling path (context + workers) -----
     from parsec_tpu import Context
@@ -155,8 +175,9 @@ def main() -> None:
 
     gflops = flops / t_task / 1e9
     graph_gflops = flops / t_graph / 1e9
+    pallas_gflops = flops / t_graph_pallas / 1e9 if t_graph_pallas else 0.0
     mono_gflops = flops / t_mono / 1e9
-    best = max(gflops, graph_gflops)
+    best = max(gflops, graph_gflops, pallas_gflops)
     print(json.dumps({
         "metric": f"dpotrf_tiled_N{N}_nb{NB}_{dtype.name}_{backend}",
         "value": round(best, 2),
@@ -164,6 +185,7 @@ def main() -> None:
         "vs_baseline": round(best / mono_gflops, 4),
         "dynamic_gflops": round(gflops, 2),
         "graph_gflops": round(graph_gflops, 2),
+        "graph_pallas_gflops": round(pallas_gflops, 2),
         "xla_monolithic_gflops": round(mono_gflops, 2),
         "rtt_ms": round(rtt * 1e3, 2),
     }))

@@ -40,7 +40,6 @@ __all__ = [
     "stencil_5pt",
     "stencil_5pt_fused",
     "flash_attention_block",
-    "pallas_available",
 ]
 
 
@@ -48,11 +47,6 @@ def _auto_interpret(interpret: Optional[bool]) -> bool:
     if interpret is not None:
         return interpret
     return jax.default_backend() != "tpu"
-
-
-def pallas_available() -> bool:
-    """True when pallas kernels can run compiled on this backend."""
-    return jax.default_backend() == "tpu"
 
 
 def _block(dim: int, want: int, align: int) -> int:
@@ -202,9 +196,6 @@ def stencil_5pt_fused(grid, iters: int, *, interpret: Optional[bool] = None):
 
 
 # -- flash attention block update ------------------------------------------
-
-_NEG_BIG = -1e30
-
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "interpret", "bq"))
 def flash_attention_block(q, k, v, acc, m, l, q_off, k_off, *,

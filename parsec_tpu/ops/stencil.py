@@ -145,7 +145,8 @@ def stencil_pallas(OLD, UP, DOWN, LEFT, RIGHT, NEW, **_):
     return stencil_5pt(OLD, up, down, left, right)
 
 
-def stencil_ptg(*, use_tpu: bool = False, use_pallas: bool = False) -> PTG:
+def stencil_ptg(*, use_tpu: bool = False, use_pallas: bool = False,
+                use_cpu: bool = True) -> PTG:
     """Build the 2D 5-point stencil PTG; instantiate with
     ``taskpool(T=iters, MT=..., NT=..., A=StencilBuffers(...))``."""
     ptg = PTG("stencil2d")
@@ -186,9 +187,11 @@ def stencil_ptg(*, use_tpu: bool = False, use_pallas: bool = False) -> PTG:
             "-> (t < T-1 and j < NT-1) ? LEFT stencil(t+1, i, j+1)",
             "-> A((t+1) % 2, i, j)")
     kw = {}
+    if use_cpu:
+        kw["cpu"] = stencil_cpu
     if use_tpu or use_pallas:
         kw["tpu"] = stencil_pallas if use_pallas else stencil_tpu
-    st.body(cpu=stencil_cpu, **kw)
+    st.body(**kw)
     return ptg
 
 

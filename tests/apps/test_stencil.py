@@ -50,11 +50,13 @@ def test_stencil_single_tile(ctx):
 
 
 def test_stencil_pallas_bodies(ctx):
-    """Pallas chore (interpret off-TPU): same numerics as the jnp body."""
+    """Pallas chore (interpret off-TPU): same numerics as the jnp body.
+    use_cpu=False drops the CPU chore so every task MUST run the pallas
+    body (the ETA-based device selection cannot fall back)."""
     rng = np.random.default_rng(2)
     grid = rng.standard_normal((16, 24)).astype(np.float32)
     A = StencilBuffers(grid, 2, 2)
-    tp = stencil_ptg(use_pallas=True).taskpool(T=3, MT=2, NT=2, A=A)
+    tp = stencil_ptg(use_pallas=True, use_cpu=False).taskpool(T=3, MT=2, NT=2, A=A)
     ctx.add_taskpool(tp)
     assert tp.wait(timeout=120)
     np.testing.assert_allclose(
