@@ -90,3 +90,124 @@ class DepTracker:
 
     def __len__(self) -> int:
         return sum(len(t) for _, t in self._shards)
+
+
+class DenseDepTracker:
+    """Dense-array dependency storage (the reference's index-array backend,
+    ``parsec_default_find_deps`` / `-M index-array`, ``jdf2c -M``).
+
+    Per registered task class, counters live in one flat array over the
+    bounding box of the class's parameter space — O(1) lookup with no
+    hashing or entry allocation, at the cost of memory proportional to the
+    box volume (the classic PTG trade; the reference allocates the same
+    dense array from the class's parameter ranges).
+
+    Keys are ``(class_name, locals_tuple)``. Classes not registered (or
+    keys outside the registered box) fall back to the hash backend, so the
+    two trackers are drop-in interchangeable: firing resets the slot to 0
+    — exactly the hash backend's delete-on-fire (and the reference's entry
+    removal), so duplicate release sequences behave identically on both.
+    """
+
+    STRIPES = 16
+
+    def __init__(self) -> None:
+        self._classes: Dict[str, Tuple[Tuple[Tuple[int, int], ...], list]] = {}
+        self._locks = [threading.Lock() for _ in range(self.STRIPES)]
+        self._fallback = DepTracker()
+        self._data: Dict[Hashable, Any] = {}
+        self._data_lock = threading.Lock()
+
+    def register_class(self, name: str, bounds: "Tuple[Tuple[int, int], ...]") -> None:
+        """``bounds``: inclusive ``(lo, hi)`` per parameter dimension."""
+        dims = [hi - lo + 1 for lo, hi in bounds]
+        vol = 1
+        for d in dims:
+            if d <= 0:
+                return  # empty space: nothing to track densely
+            vol *= d
+        self._classes[name] = (tuple(bounds), [0] * vol)
+
+    def _flat(self, name: str, locs: Tuple) -> Optional[int]:
+        reg = self._classes.get(name)
+        if reg is None:
+            return None
+        bounds, arr = reg
+        if len(locs) != len(bounds):
+            return None
+        idx = 0
+        for v, (lo, hi) in zip(locs, bounds):
+            v = int(v)
+            if v < lo or v > hi:
+                return None  # outside the box: hash fallback
+            idx = idx * (hi - lo + 1) + (v - lo)
+        return idx
+
+    def _counters(self, name: str) -> list:
+        return self._classes[name][1]
+
+    def release_counter(self, key: Hashable, goal: int, data: Any = None) -> Tuple[bool, Any]:
+        name, locs = key
+        idx = self._flat(name, locs)
+        if idx is None:
+            return self._fallback.release_counter(key, goal, data)
+        if data is not None:
+            self.set_data(key, data)
+        arr = self._counters(name)
+        with self._locks[idx % self.STRIPES]:
+            c = arr[idx] + 1
+            if c >= goal:
+                arr[idx] = 0  # delete-on-fire, like the hash backend
+                with self._data_lock:
+                    d = self._data.pop(key, None)
+                return True, d
+            arr[idx] = c
+            return False, self._data.get(key)
+
+    def release_mask(self, key: Hashable, bit: int, goal_mask: int, data: Any = None) -> Tuple[bool, Any]:
+        name, locs = key
+        idx = self._flat(name, locs)
+        if idx is None:
+            return self._fallback.release_mask(key, bit, goal_mask, data)
+        if data is not None:
+            self.set_data(key, data)
+        arr = self._counters(name)
+        with self._locks[idx % self.STRIPES]:
+            m = arr[idx] | bit
+            if (m & goal_mask) == goal_mask:
+                arr[idx] = 0  # delete-on-fire, like the hash backend
+                with self._data_lock:
+                    d = self._data.pop(key, None)
+                return True, d
+            arr[idx] = m
+            return False, self._data.get(key)
+
+    def peek(self, key: Hashable) -> Optional[DepEntry]:
+        name, locs = key
+        idx = self._flat(name, locs)
+        if idx is None:
+            return self._fallback.peek(key)
+        arr = self._counters(name)
+        with self._locks[idx % self.STRIPES]:
+            v = arr[idx]
+        if v == 0:
+            return None
+        e = DepEntry()
+        e.count = v
+        e.mask = v
+        e.data = self._data.get(key)
+        return e
+
+    def set_data(self, key: Hashable, data: Any) -> None:
+        name, locs = key if isinstance(key, tuple) and len(key) == 2 else (None, None)
+        if name is not None and self._flat(name, locs) is not None:
+            with self._data_lock:
+                self._data[key] = data
+            return
+        self._fallback.set_data(key, data)
+
+    def __len__(self) -> int:
+        n = len(self._fallback)
+        for _, arr in self._classes.values():
+            n += sum(1 for v in arr if v != 0)
+        return n

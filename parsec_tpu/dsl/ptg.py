@@ -57,7 +57,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 
 import numpy as np
 
-from ..core.deps import DepTracker
+from ..core.deps import DenseDepTracker, DepTracker
 from ..core.lifecycle import AccessMode, HookReturn, DEV_CPU, DEV_TPU
 from ..core.task import Chore, Flow, Task, TaskClass
 from ..core.taskpool import Taskpool
@@ -494,8 +494,13 @@ class PTG:
     analogue of the generated ``parsec_<name>_new(...)``, reusable with
     different problem sizes."""
 
-    def __init__(self, name: str, **constants: Any):
+    def __init__(self, name: str, *, dep_storage: Optional[str] = None,
+                 **constants: Any):
         self.name = name
+        #: dependency-storage backend: "hash" | "dense" | None (= the
+        #: ``runtime_dep_storage`` MCA param; reference: ``jdf2c -M``
+        #: dynamic-hash-table vs index-array, ``ptg-compiler/main.c:37``)
+        self.dep_storage = dep_storage
         self.constants: Dict[str, Any] = dict(constants)
         self.classes: Dict[str, PTGTaskClass] = {}
 
@@ -520,10 +525,12 @@ class PTGTaskpool(Taskpool):
         self.taskpool_type = Taskpool.TYPE_PTG
         self.ptg = ptg
         self.constants = constants
-        self.deps = DepTracker()
+        self.deps = self._make_dep_tracker()
         self.repos: Dict[str, DataRepo] = {}
         self._built: Dict[str, TaskClass] = {}
         self._local_cache: Dict[str, List[Tuple]] = {}
+        #: per-class (lo, hi) parameter bounding box, filled by _local_space
+        self._class_box: Dict[str, Tuple] = {}
         self._new_tiles: Dict[Tuple, Data] = {}
         self._new_lock = threading.Lock()
         for pc in ptg.classes.values():
@@ -534,9 +541,36 @@ class PTGTaskpool(Taskpool):
         # computed by generated code); recomputed at attach for rank != 0
         self.tdm.taskpool_set_nb_tasks(self, self._count_local(rank=0))
 
+    def _make_dep_tracker(self):
+        """Pick the dependency-storage backend (reference: per-class
+        ``-M`` choice between dynamic hash table and dense index-array,
+        ``ptg-compiler/main.c:37`` / ``parsec_internal.h:359-362``).
+
+        Dense class boxes are registered later, as a by-product of the
+        ``_count_local`` enumeration (no extra pass over the task space).
+        """
+        from ..utils.mca_param import params
+
+        mode = self.ptg.dep_storage
+        if mode is None:
+            mode = params.register(
+                "runtime", "dep_storage", "hash",
+                choices=["hash", "dense"], level=5,
+                help="PTG dependency-tracking storage: dynamic hash table "
+                     "or dense index-array over each class's parameter box")
+        if mode not in ("hash", "dense"):
+            raise ValueError(
+                f"PTG {self.ptg.name}: unknown dep_storage {mode!r} "
+                "(expected 'hash' or 'dense')")
+        return DenseDepTracker() if mode == "dense" else DepTracker()
+
     def _count_local(self, rank: int) -> int:
         self._local_cache.clear()
-        return sum(len(self._local_space(pc, rank)) for pc in self.ptg.classes.values())
+        n = sum(len(self._local_space(pc, rank)) for pc in self.ptg.classes.values())
+        if isinstance(self.deps, DenseDepTracker):
+            for name, box in self._class_box.items():
+                self.deps.register_class(name, box)
+        return n
 
     def attached(self, context) -> None:
         if context.rank != 0:
@@ -570,10 +604,22 @@ class PTGTaskpool(Taskpool):
             rank = self.context.rank if self.context else 0
         cached = self._local_cache.get(pc.name)
         if cached is None:
-            cached = [
-                loc for loc in pc.param_space(self.constants)
-                if pc.rank_of(loc, self.constants) == rank
-            ]
+            cached = []
+            lo = hi = None
+            for loc in pc.param_space(self.constants):
+                if lo is None:
+                    lo, hi = list(loc), list(loc)
+                else:
+                    for i, v in enumerate(loc):
+                        if v < lo[i]:
+                            lo[i] = v
+                        if v > hi[i]:
+                            hi[i] = v
+                if pc.rank_of(loc, self.constants) == rank:
+                    cached.append(loc)
+            if lo is not None:
+                self._class_box[pc.name] = tuple(
+                    (int(a), int(b)) for a, b in zip(lo, hi))
             self._local_cache[pc.name] = cached
         return cached
 

@@ -191,6 +191,10 @@ def stencil_ptg(*, use_tpu: bool = False, use_pallas: bool = False,
         kw["cpu"] = stencil_cpu
     if use_tpu or use_pallas:
         kw["tpu"] = stencil_pallas if use_pallas else stencil_tpu
+    if not kw:
+        raise ValueError(
+            "stencil_ptg: no BODY selected (use_cpu, use_tpu and "
+            "use_pallas are all False)")
     st.body(**kw)
     return ptg
 

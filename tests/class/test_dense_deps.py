@@ -1,0 +1,140 @@
+"""Dense index-array dependency backend (reference ``-M index-array``,
+``parsec_default_find_deps`` parsec_internal.h:359) vs the hash backend.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu.core.deps import DenseDepTracker, DepTracker
+
+
+def test_counter_mode_fires_at_goal():
+    t = DenseDepTracker()
+    t.register_class("f", ((0, 4), (0, 4)))
+    key = ("f", (2, 3))
+    assert t.release_counter(key, 3) == (False, None)
+    assert t.release_counter(key, 3) == (False, None)
+    became, _ = t.release_counter(key, 3)
+    assert became
+    # fire resets the slot (hash backend deletes the entry): one release
+    # after firing starts a fresh count, not a re-fire
+    assert t.release_counter(key, 3)[0] is False
+
+
+def test_mask_mode_requires_all_goal_bits():
+    t = DenseDepTracker()
+    t.register_class("g", ((0, 7),))
+    key = ("g", (5,))
+    assert t.release_mask(key, 0b001, 0b101)[0] is False
+    assert t.release_mask(key, 0b001, 0b101)[0] is False  # same bit again
+    assert t.release_mask(key, 0b100, 0b101)[0] is True
+    assert t.release_mask(key, 0b100, 0b101)[0] is False  # slot reset
+
+
+def test_dense_and_hash_agree_on_duplicate_release_sequences():
+    """Delete-on-fire semantics: run the same release stream through both
+    backends and compare the full fire pattern (the drop-in guarantee)."""
+    dense = DenseDepTracker()
+    dense.register_class("c", ((0, 2),))
+    hashb = DepTracker()
+    seq = [("c", (0,))] * 7 + [("c", (1,))] * 3 + [("c", (0,))] * 2
+    fires_d = [dense.release_counter(k, 3)[0] for k in seq]
+    fires_h = [hashb.release_counter(k, 3)[0] for k in seq]
+    assert fires_d == fires_h
+
+
+def test_data_is_dropped_on_fire():
+    t = DenseDepTracker()
+    t.register_class("f", ((0, 3),))
+    key = ("f", (1,))
+    t.release_counter(key, 2, data="payload")
+    became, d = t.release_counter(key, 2)
+    assert became and d == "payload"
+    assert t.peek(key) is None  # no stale data retained after fire
+
+
+def test_out_of_box_keys_fall_back_to_hash():
+    t = DenseDepTracker()
+    t.register_class("f", ((0, 3),))
+    # outside the box and a class never registered: both still correct
+    for key in [("f", (17,)), ("h", (0, 0))]:
+        assert t.release_counter(key, 2)[0] is False
+        assert t.release_counter(key, 2)[0] is True
+
+
+def test_dense_matches_hash_under_concurrency():
+    """N threads each release one dependency; exactly one sees ready,
+    for both backends."""
+    for tracker in (DepTracker(), DenseDepTracker()):
+        if isinstance(tracker, DenseDepTracker):
+            tracker.register_class("c", ((0, 0),))
+        fired = []
+        barrier = threading.Barrier(8)
+
+        def run():
+            barrier.wait()
+            became, _ = tracker.release_counter(("c", (0,)), 8)
+            if became:
+                fired.append(1)
+
+        ts = [threading.Thread(target=run) for _ in range(8)]
+        [x.start() for x in ts]
+        [x.join() for x in ts]
+        assert len(fired) == 1, type(tracker).__name__
+
+
+def test_empty_or_negative_bounds_ignored():
+    t = DenseDepTracker()
+    t.register_class("e", ((3, 2),))  # empty dim: not registered
+    assert t.release_counter(("e", (3,)), 1)[0] is True  # hash fallback
+
+
+def test_len_counts_live_entries():
+    t = DenseDepTracker()
+    t.register_class("f", ((0, 3),))
+    t.release_counter(("f", (0,)), 5)
+    t.release_counter(("f", (1,)), 1)  # fires -> not live
+    t.release_counter(("x", (9,)), 5)  # fallback entry
+    assert len(t) == 2
+
+
+def test_ptg_cholesky_dense_storage_matches_numpy():
+    """The flagship PTG runs identically under the dense backend."""
+    from parsec_tpu import Context
+    from parsec_tpu.datadist import TiledMatrix
+    from parsec_tpu.ops.cholesky import cholesky_ptg as make
+
+    n, nb = 64, 16
+    rng = np.random.default_rng(0)
+    m = rng.standard_normal((n, n))
+    S = m @ m.T + n * np.eye(n)
+
+    ptg = make(use_tpu=False, use_cpu=True)
+    ptg.dep_storage = "dense"
+    A = TiledMatrix(n, n, nb, nb, name="A", dtype=np.float64).from_array(S)
+    tp = ptg.taskpool(NT=A.mt, A=A)
+    assert isinstance(tp.deps, DenseDepTracker)
+    with Context(nb_cores=4) as ctx:
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=120)
+    L = np.tril(A.to_array())
+    np.testing.assert_allclose(L @ L.T, S, rtol=1e-8, atol=1e-8)
+
+
+def test_mca_param_selects_dense():
+    from parsec_tpu.core.lifecycle import AccessMode
+    from parsec_tpu.dsl.ptg import PTG
+    from parsec_tpu.utils.mca_param import params
+
+    params.set("runtime", "dep_storage", "dense")
+    try:
+        ptg = PTG("probe", N=1)
+        tc = ptg.task_class("t", i="0 .. N-1")
+        tc.flow("X", AccessMode.IN, "<- NONE")
+        tc.body(cpu=lambda **kw: None)
+        tp = ptg.taskpool(N=4)
+        assert isinstance(tp.deps, DenseDepTracker)
+    finally:
+        params.set("runtime", "dep_storage", "hash")
