@@ -88,6 +88,17 @@ class CommEngine(Component):
         raise NotImplementedError
 
     # -- progress -------------------------------------------------------
+    # -- datatype serialization (reference CE pack/unpack slots,
+    # parsec_comm_engine.h:190-195) --------------------------------------
+    def pack(self, dtype, buffer, offset: int = 0):
+        """Gather ``buffer`` data described by :class:`~parsec_tpu.data.
+        datatype.Datatype` ``dtype`` into contiguous wire form."""
+        return dtype.pack(buffer, offset)
+
+    def unpack(self, dtype, raw, buffer, offset: int = 0) -> None:
+        """Scatter contiguous wire data back through ``dtype``'s layout."""
+        dtype.unpack(raw, buffer, offset)
+
     def progress_nonblocking(self) -> int:
         """Drain pending incoming messages; returns #messages handled.
         Driven from worker idle loops (single-node mode of the reference,

@@ -7,8 +7,22 @@ from .datarepo import DataRepo, RepoEntry
 from .collection import DataCollection, LocalCollection
 from . import checkpoint
 from .reshape import DataCopyFuture, ReshapeSpec, get_copy_reshape, materialize
+from .datatype import (
+    Contiguous,
+    Datatype,
+    Vector,
+    type_create_contiguous,
+    type_create_vector,
+    type_of_array,
+)
 
 __all__ = [
+    "Contiguous",
+    "Datatype",
+    "Vector",
+    "type_create_contiguous",
+    "type_create_vector",
+    "type_of_array",
     "Coherency",
     "Data",
     "DataCopy",

@@ -1,0 +1,91 @@
+"""Datatype layer (reference parsec/datatype.h wrapper): contiguous and
+vector layouts, zero-copy views, wire pack/unpack, CE integration."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.data import (
+    Contiguous,
+    Vector,
+    type_create_contiguous,
+    type_create_vector,
+    type_of_array,
+)
+
+
+def test_contiguous_roundtrip_zero_copy():
+    buf = np.arange(20, dtype=np.float64)
+    dt = type_create_contiguous(8)
+    v = dt.view(buf, offset=4)
+    assert v.base is buf or v.base is not None  # a view, not a copy
+    np.testing.assert_array_equal(v, np.arange(4, 12))
+    packed = dt.pack(buf, offset=4)
+    assert packed.base is not None  # zero-copy for contiguous
+    assert dt.size == 64 and dt.extent == 64 and dt.count == 8
+
+
+def test_vector_describes_lapack_tile():
+    """A tile inside a column-major-style padded matrix: blocks=rows,
+    stride=lda (the reference's canonical vector use)."""
+    lda, rows, cols = 10, 4, 6
+    big = np.arange(lda * 8, dtype=np.float32)
+    dt = type_create_vector(blocks=cols, blocklen=rows, stride=lda,
+                            base=np.float32)
+    assert dt.size == cols * rows * 4
+    assert dt.extent == ((cols - 1) * lda + rows) * 4
+    tile = dt.view(big, offset=2)
+    assert tile.shape == (cols, rows)
+    np.testing.assert_array_equal(tile[1], np.arange(12, 16))
+
+    packed = dt.pack(big, offset=2)
+    assert packed.shape == (cols * rows,)
+    # scatter into a fresh buffer and compare views
+    out = np.zeros_like(big)
+    dt.unpack(packed, out, offset=2)
+    np.testing.assert_array_equal(dt.view(out, 2), tile)
+    # untouched padding stays zero
+    assert out[0] == 0 and out[2 + rows] == 0
+
+
+def test_vector_view_is_writable_window():
+    buf = np.zeros(12, dtype=np.int64)
+    dt = Vector(3, 2, 4, np.int64)
+    dt.view(buf)[:, :] = 7
+    assert buf.tolist() == [7, 7, 0, 0, 7, 7, 0, 0, 7, 7, 0, 0]
+
+
+def test_overlapping_vector_rejected():
+    with pytest.raises(ValueError):
+        Vector(blocks=2, blocklen=5, stride=3)
+
+
+def test_type_of_array_padded_rows():
+    a = np.zeros((6, 8), dtype=np.float32)
+    sub = a[:, :5]  # row-padded 2-D view
+    dt = type_of_array(sub)
+    assert isinstance(dt, Vector)
+    assert (dt.blocks, dt.blocklen, dt.stride) == (6, 5, 8)
+    flat = a.reshape(-1)
+    dt.view(flat)[:, :] = 3.0
+    assert (a[:, :5] == 3.0).all() and (a[:, 5:] == 0.0).all()
+
+
+def test_comm_engine_pack_unpack_slots():
+    from parsec_tpu.comm.engine import CommEngine
+
+    class _CE(CommEngine):
+        mca_name = "test"
+
+    ce = _CE()
+    buf = np.arange(16, dtype=np.float64)
+    dt = Contiguous(16, np.float64)
+    wire = ce.pack(dt, buf)
+    out = np.zeros(16)
+    ce.unpack(dt, wire, out)
+    np.testing.assert_array_equal(out, buf)
+
+
+def test_2d_buffer_accepted_when_contiguous():
+    m = np.arange(24, dtype=np.float64).reshape(4, 6)
+    dt = Contiguous(6, np.float64)
+    np.testing.assert_array_equal(dt.view(m, offset=6), m[1])
