@@ -87,7 +87,6 @@ class CommEngine(Component):
         """Pull a registered remote buffer; on_done(buffer) fires locally."""
         raise NotImplementedError
 
-    # -- progress -------------------------------------------------------
     # -- datatype serialization (reference CE pack/unpack slots,
     # parsec_comm_engine.h:190-195) --------------------------------------
     def pack(self, dtype, buffer, offset: int = 0):
@@ -99,6 +98,7 @@ class CommEngine(Component):
         """Scatter contiguous wire data back through ``dtype``'s layout."""
         dtype.unpack(raw, buffer, offset)
 
+    # -- progress -------------------------------------------------------
     def progress_nonblocking(self) -> int:
         """Drain pending incoming messages; returns #messages handled.
         Driven from worker idle loops (single-node mode of the reference,

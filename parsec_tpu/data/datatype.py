@@ -93,6 +93,7 @@ class Contiguous(Datatype):
 
     def view(self, buffer: np.ndarray, offset: int = 0) -> np.ndarray:
         buffer = _as_flat(buffer, self.base)
+        _check_span(buffer, offset, self._count, self)
         return buffer[offset:offset + self._count]
 
     def pack(self, buffer: np.ndarray, offset: int = 0) -> np.ndarray:
@@ -132,6 +133,7 @@ class Vector(Datatype):
 
     def view(self, buffer: np.ndarray, offset: int = 0) -> np.ndarray:
         buffer = _as_flat(buffer, self.base)
+        _check_span(buffer, offset, self.extent // self.base.itemsize, self)
         it = self.base.itemsize
         return np.lib.stride_tricks.as_strided(
             buffer[offset:],
@@ -143,6 +145,16 @@ class Vector(Datatype):
     def __repr__(self) -> str:
         return (f"Vector(blocks={self.blocks}, blocklen={self.blocklen}, "
                 f"stride={self.stride}, {self.base.name})")
+
+
+def _check_span(flat: np.ndarray, offset: int, need_elems: int, dt) -> None:
+    """An undersized buffer must fail loudly here — as_strided would hand
+    out an out-of-bounds view (heap corruption on write), and a silent
+    short slice would put truncated payloads on the wire."""
+    if offset < 0 or flat.shape[0] - offset < need_elems:
+        raise ValueError(
+            f"buffer too small for {dt!r}: need {need_elems} element(s) at "
+            f"offset {offset}, have {flat.shape[0]}")
 
 
 def _as_flat(buffer: np.ndarray, base: np.dtype) -> np.ndarray:

@@ -1,0 +1,174 @@
+"""Native execution engine for captured PTG taskpools.
+
+The reference's hot loop — ready-queue pops, dependency counting,
+release_deps — is native C (``scheduling.c``, ``mca/sched``); only task
+BODYs are application code.  This module reproduces that split: the
+captured DAG (:mod:`parsec_tpu.dsl.graph`) is handed to the C++ engine
+(``native/src/graph.cpp`` — atomic dependency counters, priority pool,
+native worker threads), and Python is entered once per task through a
+ctypes trampoline to run the BODY.  Dependency resolution, scheduling
+and termination detection never touch the interpreter.
+
+Scope: single-rank, CPU-chore bodies, in-place numpy tiles (the dynamic
+``Context`` path owns devices, reshape and multi-rank; the whole-DAG XLA
+lowering owns the TPU path).  This is the dispatch-bound regime — many
+small tasks — where interpreter overhead dominates the dynamic path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.lifecycle import AccessMode, DEV_CPU
+from .graph import TaskGraph, capture, source_tile
+from .ptg import CTL, PTGTaskpool
+
+
+class NativeExecutor:
+    """Run a PTG taskpool's full DAG on the native engine.
+
+    ``NativeExecutor(tp).run(nthreads=4)`` executes every task and applies
+    the declared write-backs to the backing collections, exactly like the
+    dynamic runtime's CPU path.  The taskpool must be unstarted (never
+    attached to a Context).
+    """
+
+    def __init__(self, tp: PTGTaskpool, *, graph: Optional[TaskGraph] = None):
+        from .. import native
+
+        if not native.available():
+            raise RuntimeError(
+                f"native core unavailable: {native.build_error()}")
+        self._native = native
+        self.taskpool = tp
+        self.graph = graph if graph is not None else capture(tp, ranks=[0])
+        self._new_tiles: Dict[Tuple, np.ndarray] = {}
+        self._bodies: List[Callable[[], None]] = []
+        self._build()
+
+    # -- tile resolution (same rules as ptg_to_dtd / xla_lower) ----------
+    def _payload(self, srckey: Tuple) -> np.ndarray:
+        consts = self.taskpool.constants
+        if srckey[0] == "data":
+            _, cname, key = srckey
+            d = consts[cname].data_of(*key)
+            c = d.newest_copy() or d.get_copy(0)
+            if c is None or c.payload is None:
+                raise ValueError(f"collection tile {cname}{key} has no payload")
+            return c.payload
+        t = self._new_tiles.get(srckey)
+        if t is None:
+            shape = consts.get("TILE_SHAPE", (1,))
+            dtype = consts.get("TILE_DTYPE", np.float64)
+            t = self._new_tiles[srckey] = np.zeros(shape, dtype)
+        return t
+
+    def _build(self) -> None:
+        tp = self.taskpool
+        g = self.graph
+        consts = tp.constants
+        ng = self._native.NativeGraph()
+        self._ng = ng
+        index: Dict[Tuple, int] = {}
+
+        order = list(g.nodes)
+        for tid in order:
+            node = g.nodes[tid]
+            index[tid] = ng.add_task(priority=node.priority,
+                                     user_tag=len(self._bodies))
+            self._bodies.append(self._make_body(tid))
+        for tid in order:
+            me = index[tid]
+            for (_f, succ, _sf) in g.nodes[tid].out_edges:
+                ng.add_dep(me, index[succ])
+        # commit only after EVERY edge is declared: committing a task arms
+        # it, and a task whose in-edges arrive after arming would release
+        # early (the commit token covers a task's own declaration window,
+        # which for this whole-DAG build is the full edge pass)
+        for tid in order:
+            ng.commit(index[tid])
+        ng.seal()
+
+    def _make_body(self, tid: Tuple) -> Callable[[], None]:
+        tp = self.taskpool
+        g = self.graph
+        consts = tp.constants
+        cname, locs = tid
+        pc = tp.ptg.classes[cname]
+        fn = pc.bodies.get(DEV_CPU)
+        if fn is None:
+            raise ValueError(f"native_exec: class {cname} has no CPU body")
+        node = g.nodes[tid]
+        env = pc.env_of(locs, consts)
+
+        # resolve flow kwargs lazily at execution time: a flow's source
+        # payload may be attached after construction, and "new" tiles are
+        # shared with whichever predecessor created them
+        flow_specs: List[Tuple[str, Optional[Tuple]]] = []
+        for f in pc.flows:
+            if f.mode == CTL:
+                continue
+            src = node.flow_sources.get(f.name)
+            if src is None and not (f.mode & AccessMode.OUT):
+                flow_specs.append((f.name, None))  # unmatched IN: body gets None
+            else:
+                flow_specs.append((f.name, source_tile(g, tid, f.name)))
+        scalars = {n: env[n] for n in pc.param_names + pc.def_names + pc.body_globals}
+        # write-back sources are fixed at capture time: resolve the chains
+        # once here, not on the hot dispatch path
+        write_backs = []
+        for (fname, cname2, key) in node.write_backs:
+            src = source_tile(g, tid, fname)
+            home = ("data", cname2, tuple(key))
+            write_backs.append((src if src != home else None, cname2, tuple(key)))
+
+        def body() -> None:
+            kw: Dict[str, Any] = dict(scalars)
+            for fname, srckey in flow_specs:
+                kw[fname] = None if srckey is None else self._payload(srckey)
+            fn(**kw)
+            # write-backs run at producer completion (dynamic runtime's
+            # _write_back); chain successors are DAG-ordered after us
+            for (src, cname2, key) in write_backs:
+                if src is not None:
+                    np.copyto(self._payload(("data", cname2, key)),
+                              self._payload(src))
+                consts[cname2].data_of(*key).version_bump(0)
+
+        return body
+
+    def run(self, nthreads: int = 4) -> int:
+        """Execute to quiescence; returns the number of tasks run."""
+        bodies = self._bodies
+
+        def trampoline(_task_id: int, user_tag: int) -> None:
+            bodies[user_tag]()
+
+        n = self._ng.run(trampoline, nthreads=nthreads)
+        if n != len(bodies):
+            raise RuntimeError(
+                f"native engine retired {n}/{len(bodies)} tasks")
+        return n
+
+    def close(self) -> None:
+        ng = getattr(self, "_ng", None)
+        if ng is not None:
+            ng.close()
+            self._ng = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def run_native(tp: PTGTaskpool, *, nthreads: int = 4) -> int:
+    """One-shot: capture + native execution of ``tp``."""
+    ex = NativeExecutor(tp)
+    try:
+        return ex.run(nthreads=nthreads)
+    finally:
+        ex.close()

@@ -89,3 +89,14 @@ def test_2d_buffer_accepted_when_contiguous():
     m = np.arange(24, dtype=np.float64).reshape(4, 6)
     dt = Contiguous(6, np.float64)
     np.testing.assert_array_equal(dt.view(m, offset=6), m[1])
+
+
+def test_undersized_buffer_rejected():
+    """Regression: an undersized buffer must raise, never hand out an
+    out-of-bounds strided view (heap corruption) or a short pack."""
+    with pytest.raises(ValueError, match="too small"):
+        Vector(blocks=4, blocklen=4, stride=10).view(np.zeros(8))
+    with pytest.raises(ValueError, match="too small"):
+        Contiguous(8).pack(np.zeros(4))
+    with pytest.raises(ValueError, match="too small"):
+        Contiguous(4).view(np.zeros(8), offset=6)
