@@ -37,8 +37,10 @@ def main() -> None:
 
     backend = jax.default_backend()
     on_accel = backend not in ("cpu",)
+    # nb=512 matches the north-star config (BASELINE.json) and measured
+    # best vs_baseline in the nb={512,1024,2048} sweep (BASELINE.md)
     N = int(os.environ.get("BENCH_N", "8192" if on_accel else "1024"))
-    NB = int(os.environ.get("BENCH_NB", "1024" if on_accel else "256"))
+    NB = int(os.environ.get("BENCH_NB", "512" if on_accel else "256"))
     dtype = np.dtype(os.environ.get("BENCH_DTYPE", "float32"))
 
     rng = np.random.default_rng(0)

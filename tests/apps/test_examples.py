@@ -22,6 +22,7 @@ ALL = [
     "ex08_tpu_graph.py",
     "ex09_jdf_graph.py",
     "ex10_sequence_parallel.py",
+    "ex11_pallas_native.py",
     os.path.join("dtd", "dtd_helloworld.py"),
     os.path.join("dtd", "dtd_hello_arg.py"),
     os.path.join("dtd", "dtd_untied.py"),
