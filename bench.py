@@ -62,15 +62,37 @@ def main() -> None:
     rtt = sorted(rtts)[1]
 
     def measure(fn, reps):
-        """Amortized per-iteration seconds of fn() -> array."""
+        """Amortized per-iteration seconds of fn() -> array.
+
+        Slope method: time k reps and 2k reps back-to-back and use
+        (d2 - d1) / k — any constant offset (the tunnel round-trip of the
+        final sync, dispatch ramp) cancels exactly, unlike subtracting a
+        separately-estimated RTT, which explodes when the tunnel jitters
+        by more than the compute time. Reps grow until the slope is
+        resolved against noise."""
         r = fn()
-        sync_scalar(r)  # drain queue
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            r = fn()
-        sync_scalar(r)
-        dt = time.perf_counter() - t0
-        return max((dt - rtt) / reps, 1e-9)
+        sync_scalar(r)  # warmup/drain
+        k = max(reps, 1)
+        for _ in range(6):
+            t0 = time.perf_counter()
+            for _ in range(k):
+                r = fn()
+            sync_scalar(r)
+            d1 = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for _ in range(2 * k):
+                r = fn()
+            sync_scalar(r)
+            d2 = time.perf_counter() - t0
+            diff = d2 - d1
+            if diff >= max(0.2, 0.5 * rtt) or k >= 1024:
+                break
+            k *= 4
+        if diff <= 0:
+            # pathological jitter: report the conservative upper bound
+            # (includes the sync offset) rather than a nonsense number
+            return d2 / (2 * k)
+        return diff / k
 
     reps = int(os.environ.get("BENCH_REPS", "5"))
 
