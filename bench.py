@@ -10,10 +10,13 @@ i.e. what fraction of XLA's own single-kernel performance the DAG runtime
 achieves (>= 1.0 means the tiled task graph BEATS the monolithic kernel).
 
 Measurement notes: on this harness the TPU chip is reached through a
-network tunnel whose round-trip (~70 ms) dwarfs kernel times and whose
-``block_until_ready`` does not block; timings therefore run ``reps``
-iterations back-to-back and sync once via a scalar device_get, with the
-measured RTT subtracted.
+network tunnel whose round-trip (~100 ms) dwarfs kernel times and whose
+``block_until_ready`` does not block. Per-run times therefore come from
+the slope method — time k reps and 2k reps back-to-back (one scalar
+device_get sync each) and take (d2-d1)/k, which cancels the constant
+tunnel offset exactly; reps grow until the slope resolves against
+jitter. The dynamic path times one full taskpool run and subtracts one
+RTT for its final sync.
 
 Config via env: BENCH_N (matrix size), BENCH_NB (tile size), BENCH_DTYPE,
 BENCH_REPS, BENCH_PLATFORM (force backend, e.g. "cpu" for smoke).
@@ -70,29 +73,29 @@ def main() -> None:
         separately-estimated RTT, which explodes when the tunnel jitters
         by more than the compute time. Reps grow until the slope is
         resolved against noise."""
-        r = fn()
-        sync_scalar(r)  # warmup/drain
+        def timed(n):
+            t0 = time.perf_counter()
+            r = None
+            for _ in range(n):
+                r = fn()
+            sync_scalar(r)
+            return time.perf_counter() - t0
+
+        fnr = fn()
+        sync_scalar(fnr)  # warmup/drain
         k = max(reps, 1)
-        for _ in range(6):
-            t0 = time.perf_counter()
-            for _ in range(k):
-                r = fn()
-            sync_scalar(r)
-            d1 = time.perf_counter() - t0
-            t0 = time.perf_counter()
-            for _ in range(2 * k):
-                r = fn()
-            sync_scalar(r)
-            d2 = time.perf_counter() - t0
+        while True:
+            d1 = timed(k)
+            d2 = timed(2 * k)
             diff = d2 - d1
-            if diff >= max(0.2, 0.5 * rtt) or k >= 1024:
-                break
-            k *= 4
-        if diff <= 0:
-            # pathological jitter: report the conservative upper bound
-            # (includes the sync offset) rather than a nonsense number
-            return d2 / (2 * k)
-        return diff / k
+            if diff >= max(0.2, 0.5 * rtt):
+                return diff / k  # slope resolved against noise
+            if k >= 1024:
+                # slope never resolved: report the conservative upper
+                # bound — per-rep time including the amortized sync offset
+                # — rather than a nonsense near-zero slope
+                return d2 / (2 * k)
+            k = min(k * 4, 1024)
 
     reps = int(os.environ.get("BENCH_REPS", "5"))
 
@@ -189,8 +192,11 @@ def main() -> None:
         dt = time.perf_counter() - t0
         if not ok:
             raise RuntimeError("dpotrf taskpool did not quiesce")
-        # one tunnel round-trip for the final sync, same correction as
-        # measure() applies to the graph/monolithic paths
+        # single non-repeated run: subtract the one tunnel round-trip of
+        # the final sync (dt is seconds-scale here, so unlike the repeated
+        # paths this correction cannot go negative in practice; the floor
+        # guards it regardless). The graph/monolithic paths use measure()'s
+        # slope method instead.
         return max(dt - rtt, 1e-9)
 
     dynamic_once()  # warmup: per-shape kernel compiles

@@ -1,0 +1,204 @@
+"""NativeDTD: dynamic task discovery streamed into the C++ engine."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parsec_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native core unavailable: {native.build_error()}")
+
+from parsec_tpu.dsl.dtd_native import IN, INOUT, NativeDTD  # noqa: E402
+
+
+def test_raw_chain_orders():
+    """1000-link increment chain on one tile: any misordering changes the
+    final value."""
+    x = np.zeros(4)
+
+    def bump(a):
+        a += 1
+
+    def double(a):
+        a *= 2
+
+    with NativeDTD(nthreads=4) as tp:
+        for i in range(500):
+            tp.insert_task(bump, (x, INOUT))
+            tp.insert_task(double if i == 249 else bump, (x, INOUT))
+    # 250 bumps, then x*2 at the 250th pair, then 749 more bumps... compute:
+    # sequence: pairs of (bump, bump) except pair 249 is (bump, double)
+    ref = np.zeros(4)
+    for i in range(500):
+        ref += 1
+        if i == 249:
+            ref *= 2
+        else:
+            ref += 1
+    np.testing.assert_array_equal(x, ref)
+
+
+def test_readers_run_between_writers():
+    """WAR: readers of version k must all observe version k even though a
+    later writer is already inserted."""
+    x = np.zeros(1)
+    seen = []
+    lock = threading.Lock()
+
+    def write(a, v):
+        a[0] = v
+
+    def read(a):
+        with lock:
+            seen.append(a[0])
+
+    with NativeDTD(nthreads=4) as tp:
+        tp.insert_task(write, (x, INOUT), 1.0)
+        for _ in range(8):
+            tp.insert_task(read, (x, IN))
+        tp.insert_task(write, (x, INOUT), 2.0)
+    assert seen == [1.0] * 8
+    assert x[0] == 2.0
+
+
+def test_tiled_gemm_matches_numpy():
+    rng = np.random.default_rng(0)
+    nt, nb = 4, 32
+    n = nt * nb
+    A = rng.standard_normal((n, n))
+    B = rng.standard_normal((n, n))
+    Ca = [[np.zeros((nb, nb)) for _ in range(nt)] for _ in range(nt)]
+    At = [[np.ascontiguousarray(A[i*nb:(i+1)*nb, k*nb:(k+1)*nb]) for k in range(nt)]
+          for i in range(nt)]
+    Bt = [[np.ascontiguousarray(B[k*nb:(k+1)*nb, j*nb:(j+1)*nb]) for j in range(nt)]
+          for k in range(nt)]
+
+    def gemm(c, a, b):
+        c += a @ b
+
+    with NativeDTD(nthreads=4) as tp:
+        for i in range(nt):
+            for j in range(nt):
+                for k in range(nt):
+                    tp.insert_task(gemm, (Ca[i][j], INOUT),
+                                   (At[i][k], IN), (Bt[k][j], IN))
+    C = np.block(Ca)
+    np.testing.assert_allclose(C, A @ B, rtol=1e-10, atol=1e-10)
+
+
+def test_execution_overlaps_insertion():
+    """Streaming: early tasks retire while insertion is still running."""
+    x = np.zeros(1)
+    first_done = threading.Event()
+
+    def mark(a):
+        a += 1
+        first_done.set()
+
+    tp = NativeDTD(nthreads=2)
+    tp.insert_task(mark, (x, INOUT))
+    assert first_done.wait(timeout=10), "first task did not run before seal"
+    y = np.zeros(1)
+    tp.insert_task(mark, (y, INOUT))
+    assert tp.wait(timeout=30)
+    assert x[0] == 1 and y[0] == 1
+    tp.close()
+
+
+def test_body_error_propagates():
+    def boom(a):
+        raise ValueError("native dtd body failed")
+
+    tp = NativeDTD(nthreads=2)
+    tp.insert_task(boom, (np.zeros(1), INOUT))
+    with pytest.raises(ValueError, match="native dtd body failed"):
+        tp.wait()
+
+
+def test_insert_after_seal_rejected():
+    tp = NativeDTD(nthreads=1)
+    tp.insert_task(lambda a: None, (np.zeros(1), INOUT))
+    assert tp.wait()
+    with pytest.raises(RuntimeError, match="sealed"):
+        tp.insert_task(lambda a: None, (np.zeros(1), INOUT))
+    tp.close()
+
+
+def test_same_array_in_two_args_no_self_deadlock():
+    """Regression: (x, INOUT), (x, IN) must not create a self-edge (which
+    would never satisfy and hang wait())."""
+    x = np.zeros(2)
+
+    def addself(a, b):
+        a += b + 1
+
+    with NativeDTD(nthreads=2) as tp:
+        tp.insert_task(addself, (x, INOUT), (x, IN))
+        tp.insert_task(addself, (x, INOUT), (x, INOUT))
+    np.testing.assert_array_equal(x, [3.0, 3.0])  # 0+0+1, then 1+1+1
+
+
+def test_dont_track_scratch_and_ctl():
+    from parsec_tpu.dsl.dtd_native import CTL_MODE, DONT_TRACK, SCRATCH
+
+    x = np.zeros(1)
+    order = []
+
+    def writer(a):
+        time.sleep(0.02)
+        a[0] = 1
+        order.append("w")
+
+    def untracked(a, scratch):
+        assert scratch.shape == (4,)
+        order.append("u")
+
+    import time
+
+    with NativeDTD(nthreads=2) as tp:
+        tp.insert_task(writer, (x, INOUT))
+        # DONT_TRACK: no dependency on the writer -> may run concurrently;
+        # SCRATCH: per-task buffer materialized, never tracked
+        tp.insert_task(untracked, (x, IN | DONT_TRACK), (((4,), np.float64), SCRATCH))
+    assert sorted(order) == ["u", "w"]
+    # CTL: ordering without a body argument
+    y = np.zeros(1)
+    seen = []
+
+    def w2(a):
+        a[0] = 7
+
+    def ctl_only():
+        seen.append(y[0])
+
+    with NativeDTD(nthreads=2) as tp:
+        tp.insert_task(w2, (y, INOUT))
+        tp.insert_task(ctl_only, (y, CTL_MODE))
+    assert seen == [7.0]
+
+
+def test_window_throttle_bounds_in_flight():
+    from parsec_tpu.utils.mca_param import params
+
+    params.set("dtd", "window_size", 64)
+    params.set("dtd", "threshold_size", 32)
+    try:
+        x = np.zeros(1)
+
+        def slowish(a):
+            a += 1
+
+        tp = NativeDTD(nthreads=2)
+        for _ in range(1000):
+            tp.insert_task(slowish, (x, INOUT))
+        assert tp.wait(timeout=60)
+        assert x[0] == 1000
+        # retired closures are freed (memory bounded by in-flight window)
+        assert all(b is None for b in tp._bodies)
+        tp.close()
+    finally:
+        params.set("dtd", "window_size", 2048)
+        params.set("dtd", "threshold_size", 1024)
