@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.lifecycle import AccessMode
+from ..profiling import pins
 from ..utils.mca_param import params as mca_param
 
 IN = AccessMode.IN
@@ -144,8 +145,21 @@ class NativeDTD:
             else:
                 call_args.append(a)
 
-        def task_body(_body=body, _args=tuple(call_args)) -> None:
-            _body(*_args)
+        if pins.active(pins.EXEC_BEGIN) or pins.active(pins.COMPLETE_EXEC_END):
+            from .native_exec import _TaskInfo
+
+            info = _TaskInfo(getattr(body, "__name__", "dtd_task"),
+                             f"#{self._inserted}")
+
+            def task_body(_body=body, _args=tuple(call_args)) -> None:
+                pins.fire(pins.EXEC_BEGIN, None, info)
+                _body(*_args)
+                pins.fire(pins.EXEC_END, None, info)
+                pins.fire(pins.COMPLETE_EXEC_BEGIN, None, info)
+                pins.fire(pins.COMPLETE_EXEC_END, None, info)
+        else:
+            def task_body(_body=body, _args=tuple(call_args)) -> None:
+                _body(*_args)
 
         tag = len(self._bodies)
         self._bodies.append(task_body)

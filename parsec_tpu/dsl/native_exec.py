@@ -21,9 +21,27 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+import types
+
 from ..core.lifecycle import AccessMode, DEV_CPU
+from ..profiling import pins
 from .graph import TaskGraph, capture, source_tile
 from .ptg import CTL, PTGTaskpool
+
+
+class _TaskInfo:
+    """Task stand-in for PINS subscribers on the native path: carries the
+    attributes observers read (``task_class.name``, ``prof``, ``repr``)."""
+
+    __slots__ = ("task_class", "prof", "_r")
+
+    def __init__(self, cname: str, detail: Any):
+        self.task_class = types.SimpleNamespace(name=cname)
+        self.prof: Dict[str, Any] = {}
+        self._r = f"{cname}{detail}"
+
+    def __repr__(self) -> str:
+        return self._r
 
 
 class NativeExecutor:
@@ -124,11 +142,20 @@ class NativeExecutor:
             home = ("data", cname2, tuple(key))
             write_backs.append((src if src != home else None, cname2, tuple(key)))
 
+        info = _TaskInfo(cname, locs)
+
         def body() -> None:
+            # PINS sites fire with es=None ("external" stream): the native
+            # engine owns scheduling, but observers (task_profiler, alperf,
+            # SDE, binary tracer) see the same exec/complete lifecycle as
+            # on the dynamic path
+            pins.fire(pins.EXEC_BEGIN, None, info)
             kw: Dict[str, Any] = dict(scalars)
             for fname, srckey in flow_specs:
                 kw[fname] = None if srckey is None else self._payload(srckey)
             fn(**kw)
+            pins.fire(pins.EXEC_END, None, info)
+            pins.fire(pins.COMPLETE_EXEC_BEGIN, None, info)
             # write-backs run at producer completion (dynamic runtime's
             # _write_back); chain successors are DAG-ordered after us
             for (src, cname2, key) in write_backs:
@@ -136,6 +163,7 @@ class NativeExecutor:
                     np.copyto(self._payload(("data", cname2, key)),
                               self._payload(src))
                 consts[cname2].data_of(*key).version_bump(0)
+            pins.fire(pins.COMPLETE_EXEC_END, None, info)
 
         return body
 

@@ -126,3 +126,51 @@ def test_native_dispatch_overhead_beats_dynamic():
     # opted in (local perf runs), otherwise this test is correctness-only
     if os.environ.get("PARSEC_TPU_PERF_ASSERT"):
         assert t_native <= t_dyn * 1.5, (t_native, t_dyn)
+
+
+def test_native_path_fires_pins_events():
+    """Observers (task profiler, alperf, SDE) see the same exec/complete
+    lifecycle from the native engine as from the dynamic path."""
+    from parsec_tpu.datadist import TiledMatrix
+    from parsec_tpu.dsl.native_exec import run_native
+    from parsec_tpu.ops.cholesky import cholesky_ptg
+    from parsec_tpu.profiling import pins
+
+    events = []
+    cb_b = lambda es, task: events.append(("exec", task.task_class.name, repr(task)))
+    cb_e = lambda es, task: events.append(("done", task.task_class.name, repr(task)))
+    pins.subscribe(pins.EXEC_BEGIN, cb_b)
+    pins.subscribe(pins.COMPLETE_EXEC_END, cb_e)
+    try:
+        n, nb = 64, 16  # NT=4: all four task classes appear (gemm needs NT>=3)
+        S = _spd(n, seed=5)
+        A = TiledMatrix(n, n, nb, nb, name="A", dtype=np.float64).from_array(S)
+        ran = run_native(cholesky_ptg(use_tpu=False).taskpool(NT=A.mt, A=A))
+    finally:
+        pins.unsubscribe(pins.EXEC_BEGIN, cb_b)
+        pins.unsubscribe(pins.COMPLETE_EXEC_END, cb_e)
+    assert sum(1 for e in events if e[0] == "exec") == ran
+    assert sum(1 for e in events if e[0] == "done") == ran
+    classes = {e[1] for e in events}
+    assert classes == {"potrf", "trsm", "syrk", "gemm"}
+
+
+def test_native_dtd_fires_pins_events():
+    from parsec_tpu.dsl.dtd_native import INOUT, NativeDTD
+    from parsec_tpu.profiling import pins
+
+    events = []
+    cb = lambda es, task: events.append(task.task_class.name)
+    pins.subscribe(pins.EXEC_BEGIN, cb)
+    try:
+        x = np.zeros(1)
+
+        def bump(a):
+            a += 1
+
+        with NativeDTD(nthreads=2) as tp:
+            for _ in range(5):
+                tp.insert_task(bump, (x, INOUT))
+    finally:
+        pins.unsubscribe(pins.EXEC_BEGIN, cb)
+    assert events.count("bump") == 5
