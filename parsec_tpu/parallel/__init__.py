@@ -8,6 +8,7 @@ from .mesh import best_grid, block_sharding, make_mesh, replicated
 from . import collectives
 from .ring_attention import attention_reference, ring_attention, ulysses_attention
 from .spmd import ring_gemm, spmd_cholesky, summa_gemm
+from .stencil_spmd import spmd_stencil_5pt
 
 __all__ = [
     "best_grid",
@@ -18,6 +19,7 @@ __all__ = [
     "spmd_cholesky",
     "summa_gemm",
     "ring_gemm",
+    "spmd_stencil_5pt",
     "ring_attention",
     "ulysses_attention",
     "attention_reference",
