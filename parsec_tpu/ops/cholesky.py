@@ -28,7 +28,7 @@ INOUT = AccessMode.INOUT
 
 
 def cholesky_ptg(*, use_tpu: bool = True, use_cpu: bool = True,
-                 use_pallas: bool = False) -> PTG:
+                 use_pallas: bool = False, use_trtri: bool = False) -> PTG:
     """Build the dpotrf PTG (instantiate with ``.taskpool(NT=..., A=...)``
     where ``A`` is a TiledMatrix holding the SPD matrix; the factorization
     happens in place, lower-triangular).
@@ -36,7 +36,18 @@ def cholesky_ptg(*, use_tpu: bool = True, use_cpu: bool = True,
     ``use_pallas`` swaps the syrk/gemm update TPU chores for the fused
     Pallas MXU kernels (:mod:`parsec_tpu.ops.pallas_kernels`) — the
     TPU-native analogue of the reference's hand-written CUDA BODYs
-    (``tests/runtime/cuda/nvlink.jdf:136-155``)."""
+    (``tests/runtime/cuda/nvlink.jdf:136-155``).
+
+    ``use_trtri`` adds a per-column ``trtri(k)`` task inverting the
+    factored diagonal block, turning every trsm into one MXU matmul
+    ``C @ inv(T)^T`` (standalone, 4x the XLA triangular solve at
+    nb=512) — the classic GPU-dpotrf critical-path trade. Pays off when
+    per-task dispatch latency matters (dynamic path) or solves sit on
+    the critical path; in the whole-DAG captured program XLA already
+    overlaps the solves, so there it measures neutral (BASELINE.md).
+    CPU chores then need the ``TILE_SHAPE``/``TILE_DTYPE`` constants
+    for the NEW-flow scratch (device chores are functional and ignore
+    it)."""
     ptg = PTG("dpotrf")
 
     def bodies(cpu, tpu):
@@ -54,22 +65,43 @@ def cholesky_ptg(*, use_tpu: bool = True, use_cpu: bool = True,
     potrf.priority("(NT - k) * 1000")
     potrf.flow("T", INOUT,
                "<- (k == 0) ? A(k, k) : A syrk(k-1, k)",
-               "-> T trsm(k, k+1 .. NT-1)",
+               # trtri mode: the factored block feeds the inverter, which
+               # fans the inverse out to the column's trsms
+               "-> T trtri(k)" if use_trtri else "-> T trsm(k, k+1 .. NT-1)",
                "-> A(k, k)")
     potrf.body(**bodies(tiles.potrf_cpu, tiles.potrf_tpu))
+
+    if use_trtri:
+        trtri = ptg.task_class("trtri", k="0 .. NT-2")
+        trtri.affinity("A(k, k)")
+        trtri.priority("(NT - k) * 1000 - 1")  # right behind its potrf
+        trtri.flow("T", IN, "<- T potrf(k)")
+        trtri.flow("I", INOUT,
+                   "<- NEW",
+                   "-> I trsm(k, k+1 .. NT-1)")
+        trtri.body(**bodies(tiles.trtri_cpu, tiles.trtri_tpu))
 
     trsm = ptg.task_class("trsm", k="0 .. NT-2", m="k+1 .. NT-1")
     trsm.affinity("A(m, k)")
     trsm.priority("(NT - m) * 100")
-    trsm.flow("T", IN,
-              "<- T potrf(k)")
+    if use_trtri:
+        trsm.flow("I", IN,
+                  "<- I trtri(k)")
+    else:
+        trsm.flow("T", IN,
+                  "<- T potrf(k)")
     trsm.flow("C", INOUT,
               "<- (k == 0) ? A(m, k) : A gemm(k-1, m, k)",
               "-> B syrk(k, m)",
               "-> B1 gemm(k, m, k+1 .. m-1)",
               "-> B2 gemm(k, m+1 .. NT-1, m)",
               "-> A(m, k)")
-    trsm.body(**bodies(tiles.trsm_cpu, tiles.trsm_tpu))
+    if use_trtri:
+        trsm.body(**bodies(tiles.trsm_inv_cpu,
+                           tiles.trsm_inv_pallas if use_pallas
+                           else tiles.trsm_inv_tpu))
+    else:
+        trsm.body(**bodies(tiles.trsm_cpu, tiles.trsm_tpu))
 
     syrk = ptg.task_class("syrk", k="0 .. NT-2", m="k+1 .. NT-1")
     syrk.affinity("A(m, m)")

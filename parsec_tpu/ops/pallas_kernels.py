@@ -36,6 +36,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = [
+    "matmul",
     "matmul_update",
     "stencil_5pt",
     "stencil_5pt_fused",
@@ -122,6 +123,55 @@ def matmul_update(C, A, B, *, alpha: float = -1.0, transpose_b: bool = True,
             bytes_accessed=(m * ka + n * ka + 2 * m * n) * C.dtype.itemsize,
             transcendentals=0),
     )(C, A, B)
+
+
+@functools.partial(jax.jit, static_argnames=("transpose_b", "interpret",
+                                             "bm", "bn", "bk"))
+def matmul(A, B, *, transpose_b: bool = True,
+           interpret: Optional[bool] = None,
+           bm: int = 512, bn: int = 512, bk: int = 512):
+    """``A @ B.T`` (or ``A @ B``) as a grid-blocked MXU kernel (no
+    accumulate-into input — the k==0 step initialises the output)."""
+    (m, ka) = A.shape
+    if transpose_b:
+        (n, kb) = B.shape
+        b_spec_shape = lambda bn_, bk_: pl.BlockSpec((bn_, bk_), lambda i, j, k: (j, k))
+        b_op = lambda b: b.T
+    else:
+        (kb, n) = B.shape
+        b_spec_shape = lambda bn_, bk_: pl.BlockSpec((bk_, bn_), lambda i, j, k: (k, j))
+        b_op = lambda b: b
+    assert ka == kb, (A.shape, B.shape)
+    bm_ = _block(m, bm, 128)
+    bn_ = _block(n, bn, 128)
+    bk_ = _block(ka, bk, 128)
+    grid = (m // bm_, n // bn_, ka // bk_)
+
+    def kernel(a_ref, b_ref, o_ref):
+        k = pl.program_id(2)
+
+        @pl.when(k == 0)
+        def _init():
+            o_ref[:] = jnp.zeros_like(o_ref)
+
+        o_ref[:] += jnp.dot(a_ref[:], b_op(b_ref[:]),
+                            preferred_element_type=o_ref.dtype)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((m, n), A.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm_, bk_), lambda i, j, k: (i, k)),
+            b_spec_shape(bn_, bk_),
+        ],
+        out_specs=pl.BlockSpec((bm_, bn_), lambda i, j, k: (i, j)),
+        interpret=_auto_interpret(interpret),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * n * ka,
+            bytes_accessed=(m * ka + n * ka + m * n) * A.dtype.itemsize,
+            transcendentals=0),
+    )(A, B)
 
 
 # -- 2D 5-point stencil -----------------------------------------------------

@@ -77,6 +77,32 @@ def gemm_update_tpu(A, B1, B2, **_):
 # the tile instead of product + subtract. Same BODY signature as the
 # ``*_tpu`` chores; the device module jit-dispatches them identically.
 
+def trtri_cpu(T, I, **_):
+    # I := inv(tril(T)); NEW-flow scratch I is overwritten
+    I[:] = np.linalg.solve(np.tril(T), np.eye(T.shape[0], dtype=T.dtype))
+
+
+def trtri_tpu(T, I, **_):
+    # functional: the NEW-flow input I is shape-irrelevant scratch
+    return _jsolve(T, jnp.eye(T.shape[0], dtype=T.dtype), lower=True)
+
+
+def trsm_inv_cpu(I, C, **_):
+    C[:] = C @ np.tril(I).T
+
+
+def trsm_inv_tpu(I, C, **_):
+    return jnp.dot(C, jnp.tril(I).T, precision="highest")
+
+
+def trsm_inv_pallas(I, C, **_):
+    # X = C @ inv(T)^T — the triangular solve as one MXU matmul against
+    # the per-column inverse (4x the XLA triangular solve at nb=512)
+    from .pallas_kernels import matmul
+
+    return matmul(C, I, transpose_b=True)
+
+
 def syrk_pallas(A, B, **_):
     from .pallas_kernels import matmul_update
 

@@ -95,3 +95,35 @@ def test_lowered_cholesky_pallas_chores():
     ex(block=True)
     L = np.tril(A.to_array())
     np.testing.assert_allclose(L @ L.T, S, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("use_pallas", [False, True])
+def test_lowered_cholesky_trtri_chores(use_pallas):
+    """trsm as matmul against the per-column inverse (use_trtri): same
+    factorization within f32 tolerance."""
+    n, nb = 128, 32
+    A = TiledMatrix(n, n, nb, nb, name="A", dtype=np.float32)
+    S = _spd(n, dtype=np.float32, seed=4)
+    A.from_array(S)
+    tp = cholesky_ptg(use_tpu=True, use_cpu=False, use_pallas=use_pallas,
+                      use_trtri=True).taskpool(NT=A.mt, A=A)
+    ex = GraphExecutor(tp)
+    ex(block=True)
+    L = np.tril(A.to_array())
+    np.testing.assert_allclose(L @ L.T, S, rtol=2e-3, atol=2e-3)
+
+
+def test_dynamic_cholesky_trtri_cpu():
+    from parsec_tpu import Context
+
+    n, nb = 96, 32
+    A = TiledMatrix(n, n, nb, nb, name="A", dtype=np.float64)
+    S = _spd(n, seed=5)
+    A.from_array(S)
+    tp = cholesky_ptg(use_tpu=False, use_cpu=True, use_trtri=True).taskpool(
+        NT=A.mt, A=A, TILE_SHAPE=(nb, nb), TILE_DTYPE=np.float64)
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=60)
+    L = np.tril(A.to_array())
+    np.testing.assert_allclose(L @ L.T, S, rtol=1e-8, atol=1e-8)

@@ -147,3 +147,15 @@ def test_flash_attention_block_masked_block_at_init_carry():
     assert float(jnp.abs(acc2).max()) == 0.0
     assert float(jnp.abs(l2).max()) == 0.0
     np.testing.assert_array_equal(np.asarray(m2), np.asarray(m))
+
+
+def test_matmul_plain_kernel():
+    rng = np.random.default_rng(8)
+    A = rng.standard_normal((256, 128)).astype(np.float32)
+    B = rng.standard_normal((192, 128)).astype(np.float32)
+    out = np.asarray(pk.matmul(jnp.asarray(A), jnp.asarray(B), bm=128, bn=64, bk=128))
+    np.testing.assert_allclose(out, A @ B.T, rtol=1e-4, atol=1e-4)
+    C = rng.standard_normal((128, 64)).astype(np.float32)
+    out2 = np.asarray(pk.matmul(jnp.asarray(A).T.copy(), jnp.asarray(A),
+                                transpose_b=False))
+    np.testing.assert_allclose(out2, A.T @ A, rtol=1e-4, atol=1e-4)
