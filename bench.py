@@ -114,11 +114,11 @@ def main() -> None:
     from parsec_tpu.dsl.xla_lower import GraphExecutor
     from parsec_tpu.ops import cholesky_ptg
 
-    def graph_path(use_pallas):
+    def graph_path(use_pallas, bf16_updates=False):
         """(per-run seconds, last-tile array) for the captured-DAG path."""
         Am = TiledMatrix(N, N, NB, NB, name="A", dtype=dtype).from_array(SPD)
-        tp_ = cholesky_ptg(use_tpu=True, use_cpu=False,
-                           use_pallas=use_pallas).taskpool(NT=Am.mt, A=Am)
+        tp_ = cholesky_ptg(use_tpu=True, use_cpu=False, use_pallas=use_pallas,
+                           bf16_updates=bf16_updates).taskpool(NT=Am.mt, A=Am)
         ex_ = GraphExecutor(tp_, donate=False)  # reusable feeds for reps
         fd = {k: jax.device_put(
             jnp.asarray(Am.data_of(*k[1]).newest_copy().payload))
@@ -140,6 +140,16 @@ def main() -> None:
     except Exception as e:  # pragma: no cover - pallas unavailable
         print(f"pallas path skipped: {e}", file=sys.stderr)
 
+    # mixed precision: bf16 panel operands into the MXU, f32 accumulation
+    # — tile-level precision control the monolithic kernel cannot express;
+    # only counted if it passes the same numerics bar as the f32 paths
+    t_graph_bf16 = Lb = None
+    if t_graph_pallas is not None:
+        try:
+            t_graph_bf16, Lb = graph_path(True, bf16_updates=True)
+        except Exception as e:  # pragma: no cover
+            print(f"bf16 path skipped: {e}", file=sys.stderr)
+
     # numerics: captured result must match the monolithic factorization
     L_ref = np.asarray(jax.device_get(chol(A_dev)))
     h = L_tile.shape[0]
@@ -153,6 +163,13 @@ def main() -> None:
         if not np.isfinite(errp) or errp / scale > 1e-2:
             print(f"pallas numerics off ({errp}), dropping", file=sys.stderr)
             t_graph_pallas = None
+    if t_graph_bf16 is not None:
+        # the SAME bar the f32 paths must clear — mixed precision only
+        # counts when it is numerically indistinguishable at this tolerance
+        errb = np.max(np.abs(np.tril(Lb) - np.tril(L_ref[-h:, -h:])))
+        if not np.isfinite(errb) or errb / scale > 1e-3:
+            print(f"bf16 numerics off ({errb}), dropping", file=sys.stderr)
+            t_graph_bf16 = None
 
     # ---- task runtime: dynamic scheduling path (context + workers) -----
     from parsec_tpu import Context
@@ -206,8 +223,9 @@ def main() -> None:
     gflops = flops / t_task / 1e9
     graph_gflops = flops / t_graph / 1e9
     pallas_gflops = flops / t_graph_pallas / 1e9 if t_graph_pallas else 0.0
+    bf16_gflops = flops / t_graph_bf16 / 1e9 if t_graph_bf16 else 0.0
     mono_gflops = flops / t_mono / 1e9
-    best = max(gflops, graph_gflops, pallas_gflops)
+    best = max(gflops, graph_gflops, pallas_gflops, bf16_gflops)
     print(json.dumps({
         "metric": f"dpotrf_tiled_N{N}_nb{NB}_{dtype.name}_{backend}",
         "value": round(best, 2),
@@ -216,6 +234,7 @@ def main() -> None:
         "dynamic_gflops": round(gflops, 2),
         "graph_gflops": round(graph_gflops, 2),
         "graph_pallas_gflops": round(pallas_gflops, 2),
+        "graph_pallas_bf16_gflops": round(bf16_gflops, 2),
         "xla_monolithic_gflops": round(mono_gflops, 2),
         "rtt_ms": round(rtt * 1e3, 2),
     }))

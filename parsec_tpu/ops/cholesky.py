@@ -28,7 +28,8 @@ INOUT = AccessMode.INOUT
 
 
 def cholesky_ptg(*, use_tpu: bool = True, use_cpu: bool = True,
-                 use_pallas: bool = False, use_trtri: bool = False) -> PTG:
+                 use_pallas: bool = False, use_trtri: bool = False,
+                 bf16_updates: bool = False) -> PTG:
     """Build the dpotrf PTG (instantiate with ``.taskpool(NT=..., A=...)``
     where ``A`` is a TiledMatrix holding the SPD matrix; the factorization
     happens in place, lower-triangular).
@@ -47,7 +48,12 @@ def cholesky_ptg(*, use_tpu: bool = True, use_cpu: bool = True,
     overlaps the solves, so there it measures neutral (BASELINE.md).
     CPU chores then need the ``TILE_SHAPE``/``TILE_DTYPE`` constants
     for the NEW-flow scratch (device chores are functional and ignore
-    it)."""
+    it).
+
+    ``bf16_updates`` (requires ``use_pallas``) feeds the syrk/gemm panel
+    operands to the MXU in bfloat16 with f32 accumulation — the standard
+    mixed-precision recipe; factorization accuracy drops to ~1e-2
+    relative, so it is an opt-in speed mode, not the default."""
     ptg = PTG("dpotrf")
 
     def bodies(cpu, tpu):
@@ -111,8 +117,15 @@ def cholesky_ptg(*, use_tpu: bool = True, use_cpu: bool = True,
               "-> (k == m-1) ? T potrf(m) : A syrk(k+1, m)")
     syrk.flow("B", IN,
               "<- C trsm(k, m)")
-    syrk.body(**bodies(tiles.syrk_cpu,
-                       tiles.syrk_pallas if use_pallas else tiles.syrk_tpu))
+    syrk_dev = tiles.syrk_tpu
+    gemm_dev = tiles.gemm_update_tpu
+    if use_pallas:
+        syrk_dev = tiles.syrk_pallas_bf16 if bf16_updates else tiles.syrk_pallas
+        gemm_dev = (tiles.gemm_update_pallas_bf16 if bf16_updates
+                    else tiles.gemm_update_pallas)
+    elif bf16_updates:
+        raise ValueError("bf16_updates requires use_pallas")
+    syrk.body(**bodies(tiles.syrk_cpu, syrk_dev))
 
     gemm = ptg.task_class("gemm", k="0 .. NT-3", m="k+2 .. NT-1", n="k+1 .. m-1")
     gemm.affinity("A(m, n)")
@@ -122,9 +135,7 @@ def cholesky_ptg(*, use_tpu: bool = True, use_cpu: bool = True,
               "-> (k == n-1) ? C trsm(n, m) : A gemm(k+1, m, n)")
     gemm.flow("B1", IN, "<- C trsm(k, m)")
     gemm.flow("B2", IN, "<- C trsm(k, n)")
-    gemm.body(**bodies(tiles.gemm_update_cpu,
-                       tiles.gemm_update_pallas if use_pallas
-                       else tiles.gemm_update_tpu))
+    gemm.body(**bodies(tiles.gemm_update_cpu, gemm_dev))
 
     return ptg
 

@@ -113,3 +113,21 @@ def gemm_update_pallas(A, B1, B2, **_):
     from .pallas_kernels import matmul_update
 
     return matmul_update(A, B1, B2, alpha=-1.0)
+
+
+# mixed precision: panel operands in bfloat16 (the MXU's native input
+# dtype), accumulation and the updated tile in f32 — the standard
+# mixed-precision GEMM recipe; ~0.5-1e-2 relative accuracy on dpotrf
+
+def syrk_pallas_bf16(A, B, **_):
+    from .pallas_kernels import matmul_update
+
+    b = B.astype(jnp.bfloat16)
+    return matmul_update(A, b, b, alpha=-1.0)
+
+
+def gemm_update_pallas_bf16(A, B1, B2, **_):
+    from .pallas_kernels import matmul_update
+
+    return matmul_update(A, B1.astype(jnp.bfloat16),
+                         B2.astype(jnp.bfloat16), alpha=-1.0)

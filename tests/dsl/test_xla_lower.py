@@ -127,3 +127,23 @@ def test_dynamic_cholesky_trtri_cpu():
         assert tp.wait(timeout=60)
     L = np.tril(A.to_array())
     np.testing.assert_allclose(L @ L.T, S, rtol=1e-8, atol=1e-8)
+
+
+def test_lowered_cholesky_bf16_updates():
+    """Mixed precision (bf16 panel operands, f32 accumulate): correct
+    factorization within mixed-precision tolerance."""
+    n, nb = 128, 32
+    A = TiledMatrix(n, n, nb, nb, name="A", dtype=np.float32)
+    S = _spd(n, dtype=np.float32, seed=6)
+    A.from_array(S)
+    tp = cholesky_ptg(use_tpu=True, use_cpu=False, use_pallas=True,
+                      bf16_updates=True).taskpool(NT=A.mt, A=A)
+    GraphExecutor(tp)(block=True)
+    L = np.tril(A.to_array())
+    err = np.abs(L @ L.T - S).max() / np.abs(S).max()
+    assert err < 2e-2, err
+
+
+def test_bf16_updates_requires_pallas():
+    with pytest.raises(ValueError, match="requires use_pallas"):
+        cholesky_ptg(use_pallas=False, bf16_updates=True)
