@@ -158,14 +158,14 @@ def main() -> None:
     if not np.isfinite(err) or err / scale > 1e-3:
         print(json.dumps({"error": f"numerics mismatch: {err}"}))
         raise SystemExit(1)
+    # every measured variant clears the SAME 1e-3 bar (the one the
+    # jnp-chore graph path is held to above) or is dropped
     if t_graph_pallas is not None:
         errp = np.max(np.abs(np.tril(Lp) - np.tril(L_ref[-h:, -h:])))
-        if not np.isfinite(errp) or errp / scale > 1e-2:
+        if not np.isfinite(errp) or errp / scale > 1e-3:
             print(f"pallas numerics off ({errp}), dropping", file=sys.stderr)
             t_graph_pallas = None
     if t_graph_bf16 is not None:
-        # the SAME bar the f32 paths must clear — mixed precision only
-        # counts when it is numerically indistinguishable at this tolerance
         errb = np.max(np.abs(np.tril(Lb) - np.tril(L_ref[-h:, -h:])))
         if not np.isfinite(errb) or errb / scale > 1e-3:
             print(f"bf16 numerics off ({errb}), dropping", file=sys.stderr)
@@ -225,10 +225,19 @@ def main() -> None:
     pallas_gflops = flops / t_graph_pallas / 1e9 if t_graph_pallas else 0.0
     bf16_gflops = flops / t_graph_bf16 / 1e9 if t_graph_bf16 else 0.0
     mono_gflops = flops / t_mono / 1e9
-    best = max(gflops, graph_gflops, pallas_gflops, bf16_gflops)
+    variants = {
+        "dynamic": gflops,
+        "graph": graph_gflops,
+        "graph_pallas": pallas_gflops,
+        "graph_pallas_bf16": bf16_gflops,
+    }
+    best_variant = max(variants, key=variants.get)
+    best = variants[best_variant]
     print(json.dumps({
         "metric": f"dpotrf_tiled_N{N}_nb{NB}_{dtype.name}_{backend}",
         "value": round(best, 2),
+        "best_variant": best_variant,  # bf16 = mixed precision (bf16
+        # operands, f32 accumulate/storage), numerics-gated at 1e-3
         "unit": "GFLOPS",
         "vs_baseline": round(best / mono_gflops, 4),
         "dynamic_gflops": round(gflops, 2),

@@ -52,8 +52,11 @@ def cholesky_ptg(*, use_tpu: bool = True, use_cpu: bool = True,
 
     ``bf16_updates`` (requires ``use_pallas``) feeds the syrk/gemm panel
     operands to the MXU in bfloat16 with f32 accumulation — the standard
-    mixed-precision recipe; factorization accuracy drops to ~1e-2
-    relative, so it is an opt-in speed mode, not the default."""
+    mixed-precision recipe. Only the operand cast rounds (~4e-3 per
+    element; bf16 x bf16 products are exact in f32): measured end-to-end
+    last-tile error at N=8192 is ~2e-5, passing the bench's 1e-3 gate;
+    small ill-conditioned problems can see worse (tests allow 2e-2).
+    Opt-in speed mode, not the default."""
     ptg = PTG("dpotrf")
 
     def bodies(cpu, tpu):

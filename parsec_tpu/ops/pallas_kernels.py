@@ -120,7 +120,11 @@ def matmul_update(C, A, B, *, alpha: float = -1.0, transpose_b: bool = True,
         interpret=_auto_interpret(interpret),
         cost_estimate=pl.CostEstimate(
             flops=2 * m * n * ka + m * n,
-            bytes_accessed=(m * ka + n * ka + 2 * m * n) * C.dtype.itemsize,
+            # per-operand dtypes: mixed-precision callers pass bf16 A/B
+            # with an f32 C — half the operand traffic of all-f32
+            bytes_accessed=(m * ka * A.dtype.itemsize
+                            + n * ka * B.dtype.itemsize
+                            + 2 * m * n * C.dtype.itemsize),
             transcendentals=0),
     )(C, A, B)
 

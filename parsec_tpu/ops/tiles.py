@@ -117,7 +117,10 @@ def gemm_update_pallas(A, B1, B2, **_):
 
 # mixed precision: panel operands in bfloat16 (the MXU's native input
 # dtype), accumulation and the updated tile in f32 — the standard
-# mixed-precision GEMM recipe; ~0.5-1e-2 relative accuracy on dpotrf
+# mixed-precision GEMM recipe. The casts live outside the kernel: in the
+# whole-DAG captured program XLA CSEs the per-tile cast across all its
+# consumers (one cast per trsm output); the dynamic path re-casts per
+# consuming task — acceptable there, where dispatch dominates anyway.
 
 def syrk_pallas_bf16(A, B, **_):
     from .pallas_kernels import matmul_update
