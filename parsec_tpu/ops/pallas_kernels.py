@@ -215,10 +215,14 @@ def stencil_5pt(old, up, down, left, right, *, interpret: Optional[bool] = None)
 def stencil_5pt_fused(grid, iters: int, *, interpret: Optional[bool] = None):
     """``iters`` Jacobi 5-point steps with the grid resident in VMEM.
 
-    The whole-grid roofline for the stencil study: zero HBM traffic
-    between iterations (the PTG per-iteration path pays one round-trip
-    per tile per iteration; the reference measures exactly this overlap
-    headroom in its stencil app, ``tests/apps/stencil``).
+    Scope (measured on v5e): grids must fit VMEM with headroom — up to
+    ~512x512 f32 compiles; beyond that the in-loop temporaries blow the
+    scoped-VMEM budget. At those sizes XLA's own ``fori_loop`` already
+    keeps the grid VMEM-resident, so this kernel measures parity (0.98x),
+    not a win — it exists as the explicit-residency reference point for
+    the stencil study; the real large-grid path is the per-tile PTG BODY
+    (:func:`stencil_5pt`) or the SPMD halo-exchange program
+    (:func:`parsec_tpu.parallel.spmd_stencil_5pt`).
     """
     h, w = grid.shape
 
