@@ -78,8 +78,10 @@ class NativeExecutor:
             return c.payload
         t = self._new_tiles.get(srckey)
         if t is None:
-            shape = consts.get("TILE_SHAPE", (1,))
-            dtype = consts.get("TILE_DTYPE", np.float64)
+            # ("new", producer tid, flow): per-flow NEW shape (dep
+            # [type=...] props) resolved by the taskpool
+            _, (pc_name, _locs), fname = srckey
+            shape, dtype = self.taskpool.new_tile_spec(pc_name, fname)
             t = self._new_tiles[srckey] = np.zeros(shape, dtype)
         return t
 

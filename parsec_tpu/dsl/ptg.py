@@ -682,7 +682,8 @@ class PTGTaskpool(Taskpool):
                 dt = pc.active_input_dep(f, env)
                 dep, target = dt if dt is not None else (None, None)
                 data = self._resolve_input(pc, f, target, env, task)
-                if data is not None and dep is not None and dep.props:
+                if (data is not None and dep is not None and dep.props
+                        and not isinstance(target, _NewRef)):
                     # dep-level reshape request (reference
                     # parsec_get_copy_reshape_from_dep, parsec_reshape.c);
                     # input-side reshape only makes sense for read-only
@@ -731,13 +732,38 @@ class PTGTaskpool(Taskpool):
                 f"data for flow {target.flow_name!r}")
         return data
 
+    def new_tile_spec(self, pc_name: str, flow_name: str) -> Tuple[Tuple, Any]:
+        """(shape, dtype) for a flow's ``<- NEW`` tile: a ``[shape=…]`` /
+        ``[dtype=…]`` / ``[type=NAME]`` property block on the NEW dep wins
+        (NAME resolves through the taskpool constants, so shapes may
+        depend on problem parameters); otherwise the taskpool-wide
+        ``TILE_SHAPE``/``TILE_DTYPE`` constants."""
+        shape = self.constants.get("TILE_SHAPE", (1,))
+        dtype = self.constants.get("TILE_DTYPE", np.float64)
+        pc = self.ptg.classes.get(pc_name)
+        if pc is not None:
+            for f in pc.flows:
+                if f.name != flow_name:
+                    continue
+                for dep in f.deps_in:
+                    # NEW may sit in either branch of a guarded dep
+                    if not (isinstance(dep.then, _NewRef)
+                            or isinstance(dep.otherwise, _NewRef)):
+                        continue
+                    if dep.props:
+                        rspec = ReshapeSpec.from_props(dep.props, self.constants)
+                        if rspec is not None:
+                            shape = rspec.shape or shape
+                            dtype = rspec.dtype or dtype
+                break
+        return tuple(shape), dtype
+
     def _new_tile(self, pc: PTGTaskClass, f: _PTGFlow, task: Task) -> Data:
         key = (pc.name, task.locals, f.name)
         with self._new_lock:
             d = self._new_tiles.get(key)
             if d is None:
-                shape = self.constants.get("TILE_SHAPE", (1,))
-                dtype = self.constants.get("TILE_DTYPE", np.float64)
+                shape, dtype = self.new_tile_spec(pc.name, f.name)
                 d = data_create(key, payload=np.zeros(shape, dtype))
                 self._new_tiles[key] = d
             return d

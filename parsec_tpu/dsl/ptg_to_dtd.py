@@ -54,8 +54,10 @@ def replay_via_dtd(
             return consts[cname].data_of(*key)
         d = tiles.get(srckey)
         if d is None:
-            shape = consts.get("TILE_SHAPE", (1,))
-            dtype = consts.get("TILE_DTYPE", np.float64)
+            # ("new", producer tid, flow): per-flow NEW shape (dep
+            # [type=...] props) resolved by the taskpool
+            _, (pc_name, _locs), fname = srckey
+            shape, dtype = ptg_tp.new_tile_spec(pc_name, fname)
             d = data_create(srckey, payload=np.zeros(shape, dtype))
             tiles[srckey] = d
         return d

@@ -81,11 +81,8 @@ class GraphExecutor:
         self.taskpool = tp
         self.graph: TaskGraph = capture(tp)
         order = self.graph.topo_order()
-        consts = tp.constants
         self.batch_levels = batch_levels
 
-        tile_shape = consts.get("TILE_SHAPE", (1,))
-        tile_dtype = consts.get("TILE_DTYPE", np.float32)
 
         plan: List[_Step] = []
         homes_in: List[Tuple[str, Tuple]] = []
@@ -166,7 +163,8 @@ class GraphExecutor:
                 elif src[0] == "data":
                     v = env[(src[1], tuple(src[2]))]
                 elif src[0] == "new":
-                    v = jnp.zeros(tile_shape, tile_dtype)
+                    shp, dt = tp.new_tile_spec(step.tid[0], fname)
+                    v = jnp.zeros(shp, dt)
                 else:
                     v = vals[(src[1], src[2])]
                 kwargs[fname] = v

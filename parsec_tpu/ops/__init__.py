@@ -2,5 +2,6 @@
 
 from . import tiles
 from .cholesky import cholesky_ptg, run_cholesky
+from .qr import qr_ptg, run_qr
 
-__all__ = ["tiles", "cholesky_ptg", "run_cholesky"]
+__all__ = ["tiles", "cholesky_ptg", "run_cholesky", "qr_ptg", "run_qr"]

@@ -1,0 +1,132 @@
+"""Tiled Householder QR (the second flagship PTG, ops/qr.py).
+
+Invariant-based verification: A = Q R with orthogonal Q implies
+A^T A = R^T R — checks the factorization without tracking Q. Diagonal-
+sign canonicalisation then compares R against numpy's directly.
+"""
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.datadist import TiledMatrix
+from parsec_tpu.dsl.xla_lower import GraphExecutor
+from parsec_tpu.ops.qr import qr_ptg, run_qr
+
+
+def _mk(n, dtype=np.float64, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, n)).astype(dtype)
+
+
+def _check_r(A, R, rtol):
+    # R upper triangular
+    np.testing.assert_allclose(np.tril(R, -1), 0, atol=1e-10 * max(1, np.abs(R).max()))
+    # A^T A == R^T R  (Q orthogonal)
+    np.testing.assert_allclose(R.T @ R, A.T @ A, rtol=rtol,
+                               atol=rtol * np.abs(A.T @ A).max())
+    # sign-canonical comparison against numpy
+    R_np = np.linalg.qr(A, mode="r")
+    s_ours = np.sign(np.diag(R))
+    s_np = np.sign(np.diag(R_np))
+    np.testing.assert_allclose(s_ours[:, None] * R, s_np[:, None] * R_np,
+                               rtol=rtol, atol=rtol * np.abs(R_np).max())
+
+
+@pytest.mark.parametrize("n,nb", [(64, 32), (96, 32), (128, 32)])
+def test_qr_dynamic_cpu(n, nb):
+    A0 = _mk(n, seed=n)
+    A = TiledMatrix(n, n, nb, nb, name="A", dtype=np.float64).from_array(A0)
+    with Context(nb_cores=4) as ctx:
+        run_qr(ctx, A, use_tpu=False, use_cpu=True)
+    _check_r(A0, A.to_array(), rtol=1e-9)
+
+
+def test_qr_graph_lowered():
+    n, nb = 128, 32
+    A0 = _mk(n, np.float32, seed=7)
+    A = TiledMatrix(n, n, nb, nb, name="A", dtype=np.float32).from_array(A0)
+    tp = qr_ptg(use_tpu=True, use_cpu=False).taskpool(
+        NT=A.mt, A=A, TILE_SHAPE=(nb, nb), TILE_DTYPE=np.float32,
+        QSHAPE2=(np.float32, (2 * nb, 2 * nb)))
+    GraphExecutor(tp)(block=True)
+    _check_r(A0, A.to_array(), rtol=5e-3)
+
+
+def test_qr_graph_batched_levels():
+    n, nb = 160, 32
+    A0 = _mk(n, np.float32, seed=8)
+    A = TiledMatrix(n, n, nb, nb, name="A", dtype=np.float32).from_array(A0)
+    tp = qr_ptg(use_tpu=True, use_cpu=False).taskpool(
+        NT=A.mt, A=A, TILE_SHAPE=(nb, nb), TILE_DTYPE=np.float32,
+        QSHAPE2=(np.float32, (2 * nb, 2 * nb)))
+    GraphExecutor(tp, batch_levels=True)(block=True)
+    _check_r(A0, A.to_array(), rtol=5e-3)
+
+
+def test_qr_native_engine():
+    from parsec_tpu import native
+
+    if not native.available():
+        pytest.skip(f"native core unavailable: {native.build_error()}")
+    from parsec_tpu.dsl.native_exec import run_native
+
+    n, nb = 96, 32
+    A0 = _mk(n, seed=9)
+    A = TiledMatrix(n, n, nb, nb, name="A", dtype=np.float64).from_array(A0)
+    tp = qr_ptg(use_tpu=False, use_cpu=True).taskpool(
+        NT=A.mt, A=A, TILE_SHAPE=(nb, nb), TILE_DTYPE=np.float64,
+        QSHAPE2=(np.float64, (2 * nb, 2 * nb)))
+    run_native(tp, nthreads=4)
+    _check_r(A0, A.to_array(), rtol=1e-9)
+
+
+def test_qr_single_tile():
+    A0 = _mk(32, seed=10)
+    A = TiledMatrix(32, 32, 32, 32, name="A", dtype=np.float64).from_array(A0)
+    with Context(nb_cores=2) as ctx:
+        run_qr(ctx, A, use_tpu=False, use_cpu=True)
+    _check_r(A0, A.to_array(), rtol=1e-10)
+
+
+def test_qr_via_dtd_replay():
+    """Regression: the DTD replay path must honor per-flow NEW shapes
+    ([type=QSHAPE2]) — it used to allocate Q as TILE_SHAPE and produce a
+    silently wrong factorization."""
+    from parsec_tpu.dsl.ptg_to_dtd import replay_via_dtd
+
+    n, nb = 96, 32
+    A0 = _mk(n, seed=11)
+    A = TiledMatrix(n, n, nb, nb, name="A", dtype=np.float64).from_array(A0)
+    tp = qr_ptg(use_tpu=False, use_cpu=True).taskpool(
+        NT=A.mt, A=A, TILE_SHAPE=(nb, nb), TILE_DTYPE=np.float64,
+        QSHAPE2=(np.float64, (2 * nb, 2 * nb)))
+    with Context(nb_cores=4) as ctx:
+        replay_via_dtd(tp, ctx)
+    _check_r(A0, A.to_array(), rtol=1e-9)
+
+
+def test_qr_rejects_ragged_or_rectangular():
+    with Context(nb_cores=1) as ctx:
+        bad = TiledMatrix(112, 112, 32, 32, name="A", dtype=np.float64)
+        with pytest.raises(ValueError, match="square matrix with uniform"):
+            run_qr(ctx, bad, use_tpu=False)
+        rect = TiledMatrix(64, 96, 32, 32, name="A", dtype=np.float64)
+        with pytest.raises(ValueError, match="square matrix with uniform"):
+            run_qr(ctx, rect, use_tpu=False)
+
+
+def test_new_tile_spec_guarded_otherwise_branch():
+    """[type=...] props apply when NEW sits in a guard's else-branch."""
+    from parsec_tpu.dsl.ptg import PTG
+    from parsec_tpu.core.lifecycle import AccessMode
+
+    ptg = PTG("probe")
+    tc = ptg.task_class("t", i="0 .. 1")
+    tc.flow("X", AccessMode.INOUT,
+            "<- (i > 0) ? X t(i-1) : NEW [type=XSHAPE]",
+            "-> (i < 1) ? X t(i+1)")
+    tc.body(cpu=lambda X, **_: None)
+    tp = ptg.taskpool(XSHAPE=(np.float32, (3, 5)), TILE_SHAPE=(1,))
+    shape, dtype = tp.new_tile_spec("t", "X")
+    assert shape == (3, 5) and np.dtype(dtype) == np.float32
