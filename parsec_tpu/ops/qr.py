@@ -87,9 +87,24 @@ def tsmqr_tpu(Q, C1, C2, **_):
     return s[:nb], s[nb:]
 
 
+def unmqr_pallas(Q, C, **_):
+    from .pallas_kernels import matmul
+
+    return matmul(Q.T, C, transpose_b=False)
+
+
+def tsmqr_pallas(Q, C1, C2, **_):
+    from .pallas_kernels import matmul
+
+    nb = C1.shape[0]
+    s = matmul(Q.T, jnp.vstack([C1, C2]), transpose_b=False)
+    return s[:nb], s[nb:]
+
+
 # -- the PTG -----------------------------------------------------------------
 
-def qr_ptg(*, use_tpu: bool = True, use_cpu: bool = True) -> PTG:
+def qr_ptg(*, use_tpu: bool = True, use_cpu: bool = True,
+           use_pallas: bool = False) -> PTG:
     """Build the tiled-QR PTG. Instantiate with ``.taskpool(NT=A.mt, A=A,
     TILE_SHAPE=(nb, nb), TILE_DTYPE=..., QSHAPE2=(dtype, (2*nb, 2*nb)))``
     — the NEW-flow Q blocks are allocated from ``TILE_SHAPE`` except
@@ -105,7 +120,7 @@ def qr_ptg(*, use_tpu: bool = True, use_cpu: bool = True) -> PTG:
         kw = {}
         if use_cpu:
             kw["cpu"] = cpu
-        if use_tpu:
+        if use_tpu or use_pallas:
             kw["tpu"] = tpu
         return kw
 
@@ -142,7 +157,8 @@ def qr_ptg(*, use_tpu: bool = True, use_cpu: bool = True) -> PTG:
     unmqr.flow("C", INOUT,
                "<- (k == 0) ? A(k, n) : C2 tsmqr(k-1, k, n)",
                "-> C1 tsmqr(k, k+1, n)")
-    unmqr.body(**bodies(unmqr_cpu, unmqr_tpu))
+    unmqr.body(**bodies(unmqr_cpu,
+                        unmqr_pallas if use_pallas else unmqr_tpu))
 
     tsmqr = ptg.task_class("tsmqr", k="0 .. NT-2", m="k+1 .. NT-1", n="k+1 .. NT-1")
     tsmqr.affinity("A(m, n)")
@@ -158,7 +174,8 @@ def qr_ptg(*, use_tpu: bool = True, use_cpu: bool = True) -> PTG:
                "-> (m > k+1 and n == k+1) ? B tsqrt(k+1, m)",
                "-> (m > k+1 and n > k+1) ? C2 tsmqr(k+1, m, n)",
                "-> A(m, n)")
-    tsmqr.body(**bodies(tsmqr_cpu, tsmqr_tpu))
+    tsmqr.body(**bodies(tsmqr_cpu,
+                        tsmqr_pallas if use_pallas else tsmqr_tpu))
 
     return ptg
 

@@ -130,3 +130,14 @@ def test_new_tile_spec_guarded_otherwise_branch():
     tp = ptg.taskpool(XSHAPE=(np.float32, (3, 5)), TILE_SHAPE=(1,))
     shape, dtype = tp.new_tile_spec("t", "X")
     assert shape == (3, 5) and np.dtype(dtype) == np.float32
+
+
+def test_qr_graph_pallas_chores():
+    n, nb = 128, 32
+    A0 = _mk(n, np.float32, seed=12)
+    A = TiledMatrix(n, n, nb, nb, name="A", dtype=np.float32).from_array(A0)
+    tp = qr_ptg(use_tpu=True, use_cpu=False, use_pallas=True).taskpool(
+        NT=A.mt, A=A, TILE_SHAPE=(nb, nb), TILE_DTYPE=np.float32,
+        QSHAPE2=(np.float32, (2 * nb, 2 * nb)))
+    GraphExecutor(tp)(block=True)
+    _check_r(A0, A.to_array(), rtol=5e-3)
