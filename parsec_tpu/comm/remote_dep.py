@@ -138,6 +138,24 @@ class RemoteDepManager:
                        "class": src_class})
         self.ce.send_am(TAG_ACTIVATE, dst_rank, msg)
 
+    def send_writeback(self, tp, collection_name: str, key: Tuple,
+                       payload: np.ndarray, dst_rank: int) -> None:
+        """Ship a flow's FINAL value to its home tile's owner (a PTG
+        ``-> A(...)`` output dep whose collection element lives on another
+        rank). The owner pre-counts expected write-backs as termdet
+        runtime actions, so its taskpool cannot quiesce before the data
+        lands (reference analog: the data-collection write side of
+        release_deps, DTD's data_flush for the dynamic case)."""
+        msg = {
+            "pool": tp.name,
+            "kind": "writeback",
+            "collection": collection_name,
+            "key": tuple(key),
+            "data": np.asarray(payload),
+        }
+        self.stats["writebacks_sent"] += 1
+        self.ce.send_am(TAG_ACTIVATE, dst_rank, msg)
+
     # -- receiver side ---------------------------------------------------
     def _on_activate(self, src_rank: int, msg: dict) -> None:
         tp = self._lookup_or_park(src_rank, msg, self._noobj, "parked")
@@ -145,8 +163,13 @@ class RemoteDepManager:
             self._deliver(tp, src_rank, msg)
 
     def _deliver(self, tp, src_rank: int, msg: dict) -> None:
-        self.stats["activations_recv"] += 1
         kind = msg["kind"]
+        if kind == "writeback":
+            self.stats["writebacks_recv"] += 1
+            tp.incoming_writeback(msg["collection"], tuple(msg["key"]),
+                                  msg["data"])
+            return
+        self.stats["activations_recv"] += 1
         if kind == "get":
             self.stats["get_issued"] += 1
             self.ce.get(

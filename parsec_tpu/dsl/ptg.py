@@ -575,6 +575,10 @@ class PTGTaskpool(Taskpool):
     def attached(self, context) -> None:
         if context.rank != 0:
             self.tdm.taskpool_set_nb_tasks(self, self._count_local(context.rank))
+        if context.nranks > 1:
+            n_wb = self._count_expected_writebacks(context.rank)
+            if n_wb:
+                self.tdm.taskpool_addto_runtime_actions(self, n_wb)
         super().attached(context)
 
     # -- vtable construction (the jdf2c analogue) ------------------------
@@ -825,7 +829,19 @@ class PTGTaskpool(Taskpool):
         if data is None:
             return
         dc = self.constants[t.collection_name]
-        home = dc.data_of(*t.key(env))
+        key = t.key(env)
+        if self.context is not None and self.context.nranks > 1:
+            owner = dc.rank_of(*key)
+            if owner != self.context.rank:
+                # final value of a remotely-owned home tile: ship it to
+                # the owner (who pre-counted it as a runtime action)
+                src = data.newest_copy()
+                if src is not None:
+                    self.context.comm.remote_dep.send_writeback(
+                        self, t.collection_name, key,
+                        np.asarray(src.payload), owner)
+                return
+        home = dc.data_of(*key)
         if home is data:
             return  # flow aliases its home tile
         src = data.newest_copy()
@@ -838,6 +854,38 @@ class PTGTaskpool(Taskpool):
         else:
             np.copyto(dst.payload, buf)
         home.version_bump(0)
+
+    def incoming_writeback(self, cname: str, key: Tuple, payload) -> None:
+        """Receiver half of the cross-rank final write-back: store the
+        arrived value into the home tile and retire one expected-arrival
+        runtime action (armed in :meth:`attached`)."""
+        home = self.constants[cname].data_of(*key)
+        dst = home.get_copy(0)
+        buf = np.asarray(payload)
+        if dst is None or dst.payload is None:
+            home.attach_copy(0, np.array(buf))
+        else:
+            np.copyto(dst.payload, buf)
+        home.version_bump(0)
+        self.tdm.taskpool_addto_runtime_actions(self, -1)
+
+    def _count_expected_writebacks(self, rank: int) -> int:
+        """How many remote tasks write their final flow value into a tile
+        *I* own — each is one pre-counted termdet runtime action."""
+        n = 0
+        for pc in self.ptg.classes.values():
+            for loc in pc.param_space(self.constants):
+                if pc.rank_of(loc, self.constants) == rank:
+                    continue  # local task: local write-back
+                env = pc.env_of(loc, self.constants)
+                for f in pc.flows:
+                    for dep in f.deps_out:
+                        t = dep.target(env)
+                        if isinstance(t, _DataRef):
+                            dc = self.constants[t.collection_name]
+                            if dc.rank_of(*t.key(env)) == rank:
+                                n += 1
+        return n
 
     def _remote_release(
         self,

@@ -180,3 +180,36 @@ def test_distributed_cholesky_2x2():
             h, w = A.tile_shape(i, j)
             out[i * nb : i * nb + h, j * nb : j * nb + w] = np.asarray(c.payload)
     np.testing.assert_allclose(np.tril(out), np.linalg.cholesky(SPD), rtol=1e-8, atol=1e-8)
+
+
+def test_distributed_qr_2x2():
+    """Tiled Householder QR over a 2x2 block-cyclic grid: stresses NEW-flow
+    (dense Q block) transfers across ranks — data that belongs to no
+    collection travels the producer-repo -> remote-activation path."""
+    nranks, p, q = 4, 2, 2
+    N, nb = 64, 16
+    rng = np.random.default_rng(12)
+    A0 = rng.standard_normal((N, N))
+    mats = {}
+
+    def build(rank, ctx):
+        from parsec_tpu.ops.qr import qr_ptg
+
+        A = TwoDimBlockCyclic(N, N, nb, nb, p=p, q=q, myrank=rank, name="A")
+        A.from_array(A0)
+        mats[rank] = A
+        return qr_ptg(use_tpu=False).taskpool(
+            NT=A.mt, A=A, TILE_SHAPE=(nb, nb), TILE_DTYPE=np.float64,
+            QSHAPE2=(np.float64, (2 * nb, 2 * nb)))
+
+    run_ranks(nranks, build, timeout=180)
+    out = np.zeros((N, N))
+    for r, A in mats.items():
+        for (i, j) in A.local_tiles():
+            c = A.data_of(i, j).newest_copy()
+            h, w = A.tile_shape(i, j)
+            out[i * nb : i * nb + h, j * nb : j * nb + w] = np.asarray(c.payload)
+    R = out
+    np.testing.assert_allclose(np.tril(R, -1), 0, atol=1e-10)
+    ATA = A0.T @ A0
+    np.testing.assert_allclose(R.T @ R, ATA, rtol=1e-8, atol=1e-8 * np.abs(ATA).max())
