@@ -2,6 +2,8 @@
 
 from . import tiles
 from .cholesky import cholesky_ptg, run_cholesky
+from .lu import lu_ptg, run_lu
 from .qr import qr_ptg, run_qr
 
-__all__ = ["tiles", "cholesky_ptg", "run_cholesky", "qr_ptg", "run_qr"]
+__all__ = ["tiles", "cholesky_ptg", "run_cholesky", "lu_ptg", "run_lu",
+           "qr_ptg", "run_qr"]

@@ -1,0 +1,166 @@
+"""Tiled LU factorization (no pivoting) as a PTG — third dense-LA family.
+
+The reference ecosystem's LU lives in DPLASMA (``getrf_nopiv`` for
+diagonally-dominant systems, ``getrf_incpiv`` with pairwise pivoting —
+SURVEY.md §6; neither is in the PaRSEC repo). This is the right-looking
+no-pivot variant — numerically valid for diagonally dominant or SPD-like
+matrices (the caller's responsibility, as with DPLASMA's nopiv):
+
+  for k:  getrf(k):       A[k,k]  = L_kk U_kk            (in-place LU)
+          trsm_l(k, n):   A[k,n]  = L_kk^{-1} A[k,n]          (n > k)
+          trsm_u(k, m):   A[m,k]  = A[m,k] U_kk^{-1}          (m > k)
+          gemm(k, m, n):  A[m,n] -= A[m,k] A[k,n]         (m, n > k)
+
+The gemm updates (where the FLOPs are) reuse the fused Pallas
+matmul-update kernel via ``use_pallas`` exactly like dpotrf.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.lifecycle import AccessMode
+from ..dsl.ptg import PTG
+
+IN = AccessMode.IN
+INOUT = AccessMode.INOUT
+
+try:
+    import jax.numpy as jnp
+    from jax.scipy.linalg import solve_triangular as _jsolve
+except Exception:  # pragma: no cover
+    jnp = None
+
+
+# -- tile bodies -------------------------------------------------------------
+
+def getrf_cpu(T, **_):
+    n = T.shape[0]
+    for j in range(n - 1):
+        T[j + 1:, j] /= T[j, j]
+        T[j + 1:, j + 1:] -= np.outer(T[j + 1:, j], T[j, j + 1:])
+
+
+def getrf_tpu(T, **_):
+    import jax
+
+    def step(j, a):
+        col = a[:, j] / a[j, j]
+        keep = jnp.arange(a.shape[0]) <= j
+        col = jnp.where(keep, a[:, j], col)
+        a = a.at[:, j].set(col)
+        mask = (~keep)[:, None] & (jnp.arange(a.shape[1]) > j)[None, :]
+        upd = a - jnp.outer(col, a[j, :])
+        return jnp.where(mask, upd, a)
+
+    return jax.lax.fori_loop(0, T.shape[0] - 1, step, T)
+
+
+def trsm_l_cpu(T, C, **_):
+    # C := L_kk^{-1} C with unit-diagonal L from the packed LU tile
+    L = np.tril(T, -1) + np.eye(T.shape[0], dtype=T.dtype)
+    C[:] = np.linalg.solve(L, C)
+
+
+def trsm_l_tpu(T, C, **_):
+    L = jnp.tril(T, -1) + jnp.eye(T.shape[0], dtype=T.dtype)
+    return _jsolve(L, C, lower=True, unit_diagonal=True)
+
+
+def trsm_u_cpu(T, C, **_):
+    # C := C U_kk^{-1} with upper U from the packed LU tile
+    U = np.triu(T)
+    C[:] = np.linalg.solve(U.T, C.T).T
+
+
+def trsm_u_tpu(T, C, **_):
+    return _jsolve(jnp.triu(T), C.T, lower=False, trans=1).T
+
+
+def gemm_lu_cpu(A, B1, B2, **_):
+    A -= B1 @ B2
+
+
+def gemm_lu_tpu(A, B1, B2, **_):
+    return A - jnp.dot(B1, B2, precision="highest")
+
+
+def gemm_lu_pallas(A, B1, B2, **_):
+    from .pallas_kernels import matmul_update
+
+    return matmul_update(A, B1, B2, alpha=-1.0, transpose_b=False)
+
+
+# -- the PTG -----------------------------------------------------------------
+
+def lu_ptg(*, use_tpu: bool = True, use_cpu: bool = True,
+           use_pallas: bool = False) -> PTG:
+    """Build the no-pivot tiled-LU PTG (instantiate with
+    ``.taskpool(NT=A.mt, A=A)``; in-place: L strictly-lower with unit
+    diagonal, U upper, packed into A)."""
+    ptg = PTG("getrf")
+
+    def bodies(cpu, tpu):
+        kw = {}
+        if use_cpu:
+            kw["cpu"] = cpu
+        if use_tpu or use_pallas:
+            kw["tpu"] = tpu
+        return kw
+
+    getrf = ptg.task_class("getrf", k="0 .. NT-1")
+    getrf.affinity("A(k, k)")
+    getrf.priority("(NT - k) * 1000")
+    getrf.flow("T", INOUT,
+               "<- (k == 0) ? A(k, k) : A gemm(k-1, k, k)",
+               "-> T trsm_l(k, k+1 .. NT-1)",
+               "-> T trsm_u(k, k+1 .. NT-1)",
+               "-> A(k, k)")
+    getrf.body(**bodies(getrf_cpu, getrf_tpu))
+
+    trsm_l = ptg.task_class("trsm_l", k="0 .. NT-2", n="k+1 .. NT-1")
+    trsm_l.affinity("A(k, n)")
+    trsm_l.priority("(NT - n) * 100")
+    trsm_l.flow("T", IN, "<- T getrf(k)")
+    trsm_l.flow("C", INOUT,
+                "<- (k == 0) ? A(k, n) : A gemm(k-1, k, n)",
+                "-> B2 gemm(k, k+1 .. NT-1, n)",
+                "-> A(k, n)")
+    trsm_l.body(**bodies(trsm_l_cpu, trsm_l_tpu))
+
+    trsm_u = ptg.task_class("trsm_u", k="0 .. NT-2", m="k+1 .. NT-1")
+    trsm_u.affinity("A(m, k)")
+    trsm_u.priority("(NT - m) * 100")
+    trsm_u.flow("T", IN, "<- T getrf(k)")
+    trsm_u.flow("C", INOUT,
+                "<- (k == 0) ? A(m, k) : A gemm(k-1, m, k)",
+                "-> B1 gemm(k, m, k+1 .. NT-1)",
+                "-> A(m, k)")
+    trsm_u.body(**bodies(trsm_u_cpu, trsm_u_tpu))
+
+    gemm = ptg.task_class("gemm", k="0 .. NT-2", m="k+1 .. NT-1", n="k+1 .. NT-1")
+    gemm.affinity("A(m, n)")
+    gemm.priority("(NT - m) * 10")
+    gemm.flow("A", INOUT,
+              "<- (k == 0) ? A(m, n) : A gemm(k-1, m, n)",
+              "-> (m == k+1 and n == k+1) ? T getrf(k+1)",
+              "-> (m == k+1 and n > k+1) ? C trsm_l(k+1, n)",
+              "-> (m > k+1 and n == k+1) ? C trsm_u(k+1, m)",
+              "-> (m > k+1 and n > k+1) ? A gemm(k+1, m, n)",
+              "-> A(m, n)")
+    gemm.flow("B1", IN, "<- C trsm_u(k, m)")
+    gemm.flow("B2", IN, "<- C trsm_l(k, n)")
+    gemm.body(**bodies(gemm_lu_cpu,
+                       gemm_lu_pallas if use_pallas else gemm_lu_tpu))
+
+    return ptg
+
+
+def run_lu(context, A, *, use_tpu: bool = True, use_cpu: bool = True) -> None:
+    """Factorize TiledMatrix ``A`` in place: A := L\\U (no pivoting —
+    caller guarantees diagonal dominance or similar)."""
+    tp = lu_ptg(use_tpu=use_tpu, use_cpu=use_cpu).taskpool(NT=A.mt, A=A)
+    context.add_taskpool(tp)
+    ok = tp.wait(timeout=None)
+    if not ok:
+        raise RuntimeError("lu taskpool did not quiesce")
