@@ -23,6 +23,7 @@ ALL = [
     "ex09_jdf_graph.py",
     "ex10_sequence_parallel.py",
     "ex11_pallas_native.py",
+    "ex12_qr_lu.py",
     os.path.join("dtd", "dtd_helloworld.py"),
     os.path.join("dtd", "dtd_hello_arg.py"),
     os.path.join("dtd", "dtd_untied.py"),
