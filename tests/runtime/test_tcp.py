@@ -61,3 +61,9 @@ def test_tcp_dtd_gemm_4ranks():
     # ragged tiles straddle the short limit: both wire paths saw traffic
     assert sum(o["dtd_inline"] for o in out) > 0
     assert sum(o["dtd_get"] for o in out) > 0
+
+
+def test_tcp_ptg_qr_4ranks():
+    """Distributed QR over real processes: NEW-flow Q blocks and
+    cross-rank write-backs on the wire."""
+    run_scenario("ptg_qr", 4)
