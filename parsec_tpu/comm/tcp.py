@@ -319,6 +319,13 @@ class TCPComm(CommEngine):
             got = self._poll_incoming(0.0 if sent else 0.05)
             if (sent or got) and self.context is not None:
                 self.context._notify_work()
+        # flush on shutdown: anything queued before close() must still go
+        # out — a peer may be blocked on it (e.g. barrier releases queued
+        # by _on_barrier moments before the caller closed the endpoint)
+        try:
+            self._drain_cmds()
+        except Exception:  # socket may already be failing; peers detect EOF
+            pass
 
     def _drain_cmds(self) -> int:
         """Drain the command queue, aggregating per peer into one frame

@@ -238,5 +238,17 @@ def scenario_ptg_qr(ce):
         ctx.fini()
 
 
+
+def scenario_barrier_close(ce):
+    """Regression: barrier releases queued just before close() must be
+    flushed. Late ranks enter the barrier while rank 0 is already past
+    it and about to close — without flush-on-close they hang/fail."""
+    if ce.rank >= ce.nranks // 2:
+        time.sleep(1.0)  # stagger: late ranks arrive after early ones
+    ce.barrier()
+    # early ranks fall straight through to close() in main()
+    return {}
+
+
 if __name__ == "__main__":
     main()

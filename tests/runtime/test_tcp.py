@@ -67,3 +67,9 @@ def test_tcp_ptg_qr_4ranks():
     """Distributed QR over real processes: NEW-flow Q blocks and
     cross-rank write-backs on the wire."""
     run_scenario("ptg_qr", 4)
+
+
+def test_tcp_barrier_then_immediate_close():
+    """Regression: queued barrier releases survive an immediate close()
+    (flush-on-close in the comm thread)."""
+    run_scenario("barrier_close", 4)
