@@ -139,19 +139,20 @@ class RemoteDepManager:
         self.ce.send_am(TAG_ACTIVATE, dst_rank, msg)
 
     def send_writeback(self, tp, collection_name: str, key: Tuple,
-                       payload: np.ndarray, dst_rank: int) -> None:
+                       payload: Optional[np.ndarray], dst_rank: int) -> None:
         """Ship a flow's FINAL value to its home tile's owner (a PTG
         ``-> A(...)`` output dep whose collection element lives on another
         rank). The owner pre-counts expected write-backs as termdet
         runtime actions, so its taskpool cannot quiesce before the data
         lands (reference analog: the data-collection write side of
-        release_deps, DTD's data_flush for the dynamic case)."""
+        release_deps, DTD's data_flush for the dynamic case).
+        ``payload=None`` is a pure retire for a counted-but-dataless flow."""
         msg = {
             "pool": tp.name,
             "kind": "writeback",
             "collection": collection_name,
             "key": tuple(key),
-            "data": np.asarray(payload),
+            "data": np.asarray(payload) if payload is not None else None,
         }
         self.stats["writebacks_sent"] += 1
         self.ce.send_am(TAG_ACTIVATE, dst_rank, msg)

@@ -112,7 +112,8 @@ class DenseDepTracker:
     STRIPES = 16
 
     def __init__(self) -> None:
-        self._classes: Dict[str, Tuple[Tuple[Tuple[int, int], ...], list]] = {}
+        #: name -> (bounds, counter/mask slots, per-slot mode tags)
+        self._classes: Dict[str, Tuple[Tuple[Tuple[int, int], ...], list, bytearray]] = {}
         self._locks = [threading.Lock() for _ in range(self.STRIPES)]
         self._fallback = DepTracker()
         self._data: Dict[Hashable, Any] = {}
@@ -126,13 +127,16 @@ class DenseDepTracker:
             if d <= 0:
                 return  # empty space: nothing to track densely
             vol *= d
-        self._classes[name] = (tuple(bounds), [0] * vol)
+        # third element: per-slot mode tag (0 untouched / 1 counter /
+        # 2 mask) so peek() can report the right DepEntry field — the raw
+        # slot value alone cannot distinguish count 3 from mask 0b11
+        self._classes[name] = (tuple(bounds), [0] * vol, bytearray(vol))
 
     def _flat(self, name: str, locs: Tuple) -> Optional[int]:
         reg = self._classes.get(name)
         if reg is None:
             return None
-        bounds, arr = reg
+        bounds = reg[0]
         if len(locs) != len(bounds):
             return None
         idx = 0
@@ -153,15 +157,17 @@ class DenseDepTracker:
             return self._fallback.release_counter(key, goal, data)
         if data is not None:
             self.set_data(key, data)
-        arr = self._counters(name)
+        _, arr, modes = self._classes[name]
         with self._locks[idx % self.STRIPES]:
             c = arr[idx] + 1
             if c >= goal:
                 arr[idx] = 0  # delete-on-fire, like the hash backend
+                modes[idx] = 0
                 with self._data_lock:
                     d = self._data.pop(key, None)
                 return True, d
             arr[idx] = c
+            modes[idx] = 1
             return False, self._data.get(key)
 
     def release_mask(self, key: Hashable, bit: int, goal_mask: int, data: Any = None) -> Tuple[bool, Any]:
@@ -171,31 +177,41 @@ class DenseDepTracker:
             return self._fallback.release_mask(key, bit, goal_mask, data)
         if data is not None:
             self.set_data(key, data)
-        arr = self._counters(name)
+        _, arr, modes = self._classes[name]
         with self._locks[idx % self.STRIPES]:
             m = arr[idx] | bit
             if (m & goal_mask) == goal_mask:
                 arr[idx] = 0  # delete-on-fire, like the hash backend
+                modes[idx] = 0
                 with self._data_lock:
                     d = self._data.pop(key, None)
                 return True, d
             arr[idx] = m
+            modes[idx] = 2
             return False, self._data.get(key)
 
     def peek(self, key: Hashable) -> Optional[DepEntry]:
+        """Drop-in equivalent of the hash backend's peek: an entry exists
+        while the slot has pending state OR set_data stored front-end
+        scratch for the key; count/mask report only the field matching the
+        mode actually used on the slot."""
         name, locs = key
         idx = self._flat(name, locs)
         if idx is None:
             return self._fallback.peek(key)
-        arr = self._counters(name)
+        _, arr, modes = self._classes[name]
         with self._locks[idx % self.STRIPES]:
             v = arr[idx]
-        if v == 0:
+            mode = modes[idx]
+        data = self._data.get(key)
+        if v == 0 and data is None:
             return None
         e = DepEntry()
-        e.count = v
-        e.mask = v
-        e.data = self._data.get(key)
+        if mode == 1:
+            e.count = v
+        elif mode == 2:
+            e.mask = v
+        e.data = data
         return e
 
     def set_data(self, key: Hashable, data: Any) -> None:
@@ -208,6 +224,14 @@ class DenseDepTracker:
 
     def __len__(self) -> int:
         n = len(self._fallback)
-        for _, arr in self._classes.values():
+        for _, arr, _modes in self._classes.values():
             n += sum(1 for v in arr if v != 0)
+        # data-only entries (set_data with no pending release) exist for
+        # peek() just like the hash backend's — count them once
+        with self._data_lock:
+            for key in self._data:
+                name, locs = key
+                idx = self._flat(name, locs)
+                if idx is not None and self._counters(name)[idx] == 0:
+                    n += 1
         return n

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -40,11 +41,12 @@ CTL_MODE = AccessMode.CTL
 
 
 class _Tile:
-    __slots__ = ("last_writer", "readers")
+    __slots__ = ("last_writer", "readers", "_wr")
 
     def __init__(self) -> None:
         self.last_writer: int = -1   # native task id
         self.readers: List[int] = []
+        self._wr: Any = None         # weakref keeping the id()-key honest
 
 
 class NativeDTD:
@@ -108,10 +110,19 @@ class NativeDTD:
             self._errors.append(e)
 
     def _tile(self, arr: np.ndarray) -> _Tile:
+        """Tile state keyed by id(arr).  A weakref callback evicts the
+        entry the moment the array dies, so a recycled id can never
+        inherit a dead tile's last_writer/readers (and the dict stays
+        bounded by *live* tracked arrays, not arrays ever inserted)."""
         key = id(arr)
         t = self._tiles.get(key)
         if t is None:
             t = self._tiles[key] = _Tile()
+            try:
+                t._wr = weakref.ref(
+                    arr, lambda _r, k=key: self._tiles.pop(k, None))
+            except TypeError:
+                t._wr = None  # non-weakreffable objects: caller keeps alive
         return t
 
     def insert_task(self, body: Callable, *args: Any, priority: int = 0) -> int:

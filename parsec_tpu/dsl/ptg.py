@@ -791,7 +791,12 @@ class PTGTaskpool(Taskpool):
                     if t is None or isinstance(t, (_NoneRef, _NewRef)):
                         continue
                     if isinstance(t, _DataRef):
-                        self._write_back(t, env, data)
+                        if f.mode != CTL:
+                            # CTL flows carry no data: never written back,
+                            # and _count_expected_writebacks skips them too
+                            # (count and send conditions must be identical
+                            # or the owner's termdet never quiesces)
+                            self._write_back(t, env, data)
                         continue
                     succ_pc = self.ptg.classes[t.class_name]
                     for locs in _expand_args(t.args, env):
@@ -826,21 +831,24 @@ class PTGTaskpool(Taskpool):
         return release_deps
 
     def _write_back(self, t: _DataRef, env, data: Optional[Data]) -> None:
-        if data is None:
-            return
         dc = self.constants[t.collection_name]
         key = t.key(env)
         if self.context is not None and self.context.nranks > 1:
             owner = dc.rank_of(*key)
             if owner != self.context.rank:
                 # final value of a remotely-owned home tile: ship it to
-                # the owner (who pre-counted it as a runtime action)
-                src = data.newest_copy()
-                if src is not None:
-                    self.context.comm.remote_dep.send_writeback(
-                        self, t.collection_name, key,
-                        np.asarray(src.payload), owner)
+                # the owner (who pre-counted it as a runtime action).  A
+                # flow that resolved to no data still sends a payload-less
+                # retire so the owner's count drains — count and send must
+                # stay in lockstep or the owner hangs in wait().
+                src = data.newest_copy() if data is not None else None
+                self.context.comm.remote_dep.send_writeback(
+                    self, t.collection_name, key,
+                    np.asarray(src.payload) if src is not None else None,
+                    owner)
                 return
+        if data is None:
+            return
         home = dc.data_of(*key)
         if home is data:
             return  # flow aliases its home tile
@@ -858,15 +866,18 @@ class PTGTaskpool(Taskpool):
     def incoming_writeback(self, cname: str, key: Tuple, payload) -> None:
         """Receiver half of the cross-rank final write-back: store the
         arrived value into the home tile and retire one expected-arrival
-        runtime action (armed in :meth:`attached`)."""
-        home = self.constants[cname].data_of(*key)
-        dst = home.get_copy(0)
-        buf = np.asarray(payload)
-        if dst is None or dst.payload is None:
-            home.attach_copy(0, np.array(buf))
-        else:
-            np.copyto(dst.payload, buf)
-        home.version_bump(0)
+        runtime action (armed in :meth:`attached`).  ``payload=None`` is a
+        pure retire: the producer's flow resolved to no data, but the
+        arrival was pre-counted so it must still drain the counter."""
+        if payload is not None:
+            home = self.constants[cname].data_of(*key)
+            dst = home.get_copy(0)
+            buf = np.asarray(payload)
+            if dst is None or dst.payload is None:
+                home.attach_copy(0, np.array(buf))
+            else:
+                np.copyto(dst.payload, buf)
+            home.version_bump(0)
         self.tdm.taskpool_addto_runtime_actions(self, -1)
 
     def _count_expected_writebacks(self, rank: int) -> int:
@@ -879,6 +890,8 @@ class PTGTaskpool(Taskpool):
                     continue  # local task: local write-back
                 env = pc.env_of(loc, self.constants)
                 for f in pc.flows:
+                    if f.mode == CTL:
+                        continue  # never written back (see release_deps)
                     for dep in f.deps_out:
                         t = dep.target(env)
                         if isinstance(t, _DataRef):

@@ -32,6 +32,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..core.lifecycle import AccessMode, DEV_CPU, DEV_TPU
+from ..utils import debug
 from .graph import TaskGraph, capture
 from .ptg import CTL, PTGTaskpool
 
@@ -82,6 +83,9 @@ class GraphExecutor:
         self.graph: TaskGraph = capture(tp)
         order = self.graph.topo_order()
         self.batch_levels = batch_levels
+        #: groups that fell back to per-task emission (observable so a
+        #: silently-unbatched program can be diagnosed)
+        self.batch_fallbacks = 0
 
 
         plan: List[_Step] = []
@@ -238,9 +242,20 @@ class GraphExecutor:
                             return _body(**kw)
 
                         outs = _jax.vmap(grouped)(stacked, pstack)
-                    except Exception:
-                        # ragged member or non-traceable scalar use: emit
-                        # this group per-task instead
+                    except (TypeError, ValueError, IndexError) as e:
+                        # ragged member (stack shape mismatch) or
+                        # non-traceable scalar use (jax concretization
+                        # errors subclass TypeError; non-concrete boolean
+                        # indexing subclasses IndexError): emit this group
+                        # per-task instead.  Anything else — a genuine
+                        # body bug, OOM — propagates.
+                        self.batch_fallbacks += 1
+                        debug.verbose(
+                            2, "xla_lower",
+                            "batch_levels: group of %d %s tasks fell back "
+                            "to per-task emission (%s: %s)",
+                            len(members), step0.body.__name__,
+                            type(e).__name__, e)
                         for step, kwargs in members:
                             kw = dict(kwargs)
                             kw.update(step.params)

@@ -159,6 +159,14 @@ def lu_ptg(*, use_tpu: bool = True, use_cpu: bool = True,
 def run_lu(context, A, *, use_tpu: bool = True, use_cpu: bool = True) -> None:
     """Factorize TiledMatrix ``A`` in place: A := L\\U (no pivoting —
     caller guarantees diagonal dominance or similar)."""
+    if A.m != A.n or A.mb != A.nb:
+        # ragged last row/col (N % nb != 0) is fine — all tile-level
+        # solves/gemms stay shape-consistent for a square matrix with
+        # square tiles (verified vs numpy); a non-square matrix or
+        # non-square tiles would silently factorize only a leading block
+        raise ValueError(
+            f"tiled LU needs a square matrix with square tiles; "
+            f"got {A.m}x{A.n}, tiles {A.mb}x{A.nb}")
     tp = lu_ptg(use_tpu=use_tpu, use_cpu=use_cpu).taskpool(NT=A.mt, A=A)
     context.add_taskpool(tp)
     ok = tp.wait(timeout=None)

@@ -13,7 +13,7 @@ import pytest
 from parsec_tpu import Context
 from parsec_tpu.comm import InprocFabric
 from parsec_tpu.datadist import TiledMatrix, TwoDimBlockCyclic
-from parsec_tpu.dsl.ptg import PTG, IN, INOUT
+from parsec_tpu.dsl.ptg import PTG, CTL, IN, INOUT
 from parsec_tpu.data import LocalCollection
 
 
@@ -213,3 +213,31 @@ def test_distributed_qr_2x2():
     np.testing.assert_allclose(np.tril(R, -1), 0, atol=1e-10)
     ATA = A0.T @ A0
     np.testing.assert_allclose(R.T @ R, ATA, rtol=1e-8, atol=1e-8 * np.abs(ATA).max())
+
+
+def test_ctl_and_dataless_writeback_do_not_hang():
+    """Regression: a CTL flow (or a flow that resolves to no data) with a
+    ``-> D(k)`` output dep targeting a REMOTE collection element.  The
+    owner pre-counts expected write-backs as termdet runtime actions; the
+    sender must either skip the count (CTL) or send a payload-less retire
+    (dataless flow) — a counted-but-never-sent write-back hangs the owner
+    forever in wait()."""
+    nranks = 2
+    ran = []
+
+    def build(rank, ctx):
+        dc = LocalCollection("D", shape=(4,), nodes=nranks, myrank=rank,
+                            init=lambda k: np.zeros(4))
+        dc.rank_of = lambda *key: dc.data_key(*key) % nranks
+
+        ptg = PTG("ctlwb")
+        a = ptg.task_class("a")
+        a.affinity("D(1)")                    # runs on rank 1
+        a.flow("X", INOUT, "<- D(1)", "-> D(1)")
+        a.flow("C", CTL, "-> D(0)")           # CTL targeting rank 0's tile
+        a.flow("Y", IN, "<- NONE", "-> D(0)")  # dataless flow, same target
+        a.body(cpu=lambda X, Y: ran.append(rank))
+        return ptg.taskpool(D=dc)
+
+    run_ranks(nranks, build, timeout=20)
+    assert ran == [1]
