@@ -49,6 +49,7 @@ from .engine import CommEngine, MAX_AM_TAGS
 
 # internal tag space (reference registers internal GET/PUT AM tags at init,
 # parsec_mpi_funnelled.c:583-592); user tags must stay below these.
+TAG_FIN = MAX_AM_TAGS - 4         # 8: close handshake, last frame ever sent
 TAG_BARRIER = MAX_AM_TAGS - 3     # 9
 TAG_GET_REQ = MAX_AM_TAGS - 2     # 10
 TAG_GET_ANS = MAX_AM_TAGS - 1     # 11
@@ -110,6 +111,12 @@ class TCPComm(CommEngine):
         self._wake_r.setblocking(False)
         self._wake_w.setblocking(False)  # a full wake pipe is skipped, not blocked on
         self._closing = threading.Event()
+        #: ranks whose FIN frame arrived (touched only on the comm thread)
+        self._peer_fin: set = set()
+        self.close_timeout = 10.0
+        #: wedged-peer bound for one frame write; close() must wait out at
+        #: least one full send before giving up on the comm thread
+        self.send_timeout = 30.0
         self._barrier_epoch = 0
         self._barrier_state: Dict[int, Any] = {}
         self._barrier_cv = threading.Condition()
@@ -119,9 +126,13 @@ class TCPComm(CommEngine):
         if nranks > 1:
             self._bootstrap(rendezvous_dir, peers, host, connect_timeout)
 
-        self.register_am(TAG_GET_REQ, self._on_get_req)
-        self.register_am(TAG_GET_ANS, self._on_get_ans)
-        self.register_am(TAG_BARRIER, self._on_barrier)
+        # internal handlers bind directly (the comm thread isn't running
+        # yet, so no message can race these); register_am refuses the
+        # internal band so a user callback can never shadow them
+        self._am[TAG_GET_REQ] = self._on_get_req
+        self._am[TAG_GET_ANS] = self._on_get_ans
+        self._am[TAG_BARRIER] = self._on_barrier
+        self._am[TAG_FIN] = self._on_fin
 
         self._thread = threading.Thread(
             target=self._comm_main, name=f"parsec-comm-{rank}", daemon=True)
@@ -190,8 +201,10 @@ class TCPComm(CommEngine):
 
     # -- AM --------------------------------------------------------------
     def register_am(self, tag: int, cb) -> None:
-        if tag >= MAX_AM_TAGS:
-            raise ValueError(f"tag {tag} out of tag space")
+        if tag >= TAG_FIN:
+            raise ValueError(
+                f"tag {tag} is in the internal band [{TAG_FIN}, "
+                f"{MAX_AM_TAGS}) (FIN/barrier/get handshakes)")
         with self._am_lock:
             self._am[tag] = cb
             parked = self._unclaimed.pop(tag, None)
@@ -313,19 +326,47 @@ class TCPComm(CommEngine):
     # -- comm thread -----------------------------------------------------
     def _comm_main(self) -> None:
         """The funnelled progress loop (reference
-        ``remote_dep_dequeue_main`` → ``…nothread_progress``)."""
-        while not self._closing.is_set():
+        ``remote_dep_dequeue_main`` → ``…nothread_progress``).
+
+        Shutdown is a deterministic close handshake, not flag-racing
+        (reference fini tears down only after progress quiesces,
+        ``parsec_mpi_funnelled.c:527``): when ``close()`` sets ``_closing``
+        the loop queues one FIN frame to every live peer — FIFO-ordered
+        after everything queued before close, so barrier releases etc.
+        always precede it on the wire — then KEEPS progressing (flushing
+        sends, reading and dispatching peers' traffic) until its own queue
+        drained and every live peer's FIN arrived.  A peer's FIN is the
+        last frame that peer will ever send, so once all are in, no data
+        can be lost by closing the sockets; peers that vanished (EOF)
+        stop being waited on."""
+        fin_sent = False
+        fin_deadline = 0.0
+        while True:
             sent = self._drain_cmds()
             got = self._poll_incoming(0.0 if sent else 0.05)
             if (sent or got) and self.context is not None:
                 self.context._notify_work()
-        # flush on shutdown: anything queued before close() must still go
-        # out — a peer may be blocked on it (e.g. barrier releases queued
-        # by _on_barrier moments before the caller closed the endpoint)
-        try:
-            self._drain_cmds()
-        except Exception:  # socket may already be failing; peers detect EOF
-            pass
+            if not self._closing.is_set():
+                continue
+            if not fin_sent:
+                fin_sent = True
+                fin_deadline = time.monotonic() + self.close_timeout
+                for r in list(self._socks):
+                    self._cmds.put((r, TAG_FIN, None))
+                continue  # next iteration flushes the FINs
+            if self._cmds.empty() and all(
+                    r in self._peer_fin for r in self._socks):
+                break
+            if time.monotonic() > fin_deadline:
+                lagging = sorted(set(self._socks) - self._peer_fin)
+                debug.error(
+                    "rank %d: close handshake timed out after %.1fs "
+                    "(no FIN from rank(s) %s)",
+                    self.rank, self.close_timeout, lagging)
+                break
+
+    def _on_fin(self, src: int, _payload: Any) -> None:
+        self._peer_fin.add(src)
 
     def _drain_cmds(self) -> int:
         """Drain the command queue, aggregating per peer into one frame
@@ -356,11 +397,24 @@ class TCPComm(CommEngine):
             except OSError as e:
                 if not self._closing.is_set():
                     debug.error("rank %d: send to %d failed: %s", self.rank, dst, e)
+                else:
+                    # close-phase sends (barrier releases, FIN) are
+                    # load-bearing for the handshake: a failure here is why
+                    # a peer would later report a missing FIN
+                    debug.verbose(1, "comm",
+                                  "rank %d: close-phase send to %d failed: %s",
+                                  self.rank, dst, e)
         return n
 
     def _send_tracked(self, sock: socket.socket, data: bytes) -> None:
+        """Write the whole frame or raise.  Deliberately does NOT abort on
+        ``_closing`` — the close handshake flushes queued frames AFTER the
+        flag is set (an earlier version bailed here, silently dropping the
+        final barrier releases).  A wedged peer is bounded by a deadline
+        instead."""
         view = memoryview(data)
-        while view and not self._closing.is_set():
+        deadline = time.monotonic() + self.send_timeout
+        while view:
             try:
                 sent = sock.send(view)
                 view = view[sent:]
@@ -369,6 +423,10 @@ class TCPComm(CommEngine):
                 # frames); keep draining incoming traffic while waiting
                 # for writability, or both comm threads deadlock with
                 # full kernel buffers
+                if time.monotonic() > deadline:
+                    raise OSError(
+                        f"send wedged for {self.send_timeout:.0f}s "
+                        f"({len(view)} bytes unsent)")
                 self._poll_incoming(0.0)
                 select.select([], [sock], [], 0.05)
 
@@ -440,6 +498,11 @@ class TCPComm(CommEngine):
         self.close()
 
     def close(self) -> None:
+        """Initiate the FIN handshake and join the comm thread.  Returns
+        once every queued frame reached the kernel and every live peer
+        confirmed (via its own FIN) that it will send nothing more — i.e.
+        closing the sockets below cannot discard anything a peer is still
+        blocked on."""
         if self._closing.is_set():
             return
         self._closing.set()
@@ -447,7 +510,10 @@ class TCPComm(CommEngine):
             self._wake_w.send(b"\0")
         except OSError:
             pass
-        self._thread.join(timeout=5.0)
+        # must outlast one full wedged send + the FIN wait: closing the
+        # sockets under a comm thread still mid-frame would truncate a
+        # peer's length-prefixed stream
+        self._thread.join(timeout=self.send_timeout + self.close_timeout + 5.0)
         for s in self._socks.values():
             try:
                 s.close()

@@ -250,5 +250,28 @@ def scenario_barrier_close(ce):
     return {}
 
 
+def scenario_send_then_close(ce):
+    """The close handshake's stronger guarantee: an AM sent IMMEDIATELY
+    before close() must still reach a peer that isn't even reading yet.
+    Rank 0 fires one AM at every peer and closes in the same breath; the
+    peers sleep first, then must observe the payload — close() may not
+    return until every queued frame is irrevocably deliverable (peer FIN
+    received), so nothing rides on scheduling luck."""
+    got = []
+    ce.register_am(TAG_USER_BASE, lambda src, p: got.append((src, p)))
+    ce.barrier()
+    if ce.rank == 0:
+        for dst in range(1, ce.nranks):
+            ce.send_am(TAG_USER_BASE, dst, {"fin_race": dst})
+        return {"got": 0}  # falls straight through to close() in main()
+    time.sleep(1.5)  # close() on rank 0 long since initiated
+    deadline = time.time() + 30
+    while not got:
+        time.sleep(0.005)
+        assert time.time() < deadline, "last-breath AM never arrived"
+    assert got[0][1] == {"fin_race": ce.rank}
+    return {"got": len(got)}
+
+
 if __name__ == "__main__":
     main()

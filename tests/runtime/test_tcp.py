@@ -73,3 +73,11 @@ def test_tcp_barrier_then_immediate_close():
     """Regression: queued barrier releases survive an immediate close()
     (flush-on-close in the comm thread)."""
     run_scenario("barrier_close", 4)
+
+
+def test_tcp_send_then_immediate_close():
+    """An AM sent in the same breath as close() must reach a peer that
+    starts reading only later (the FIN handshake makes close() block
+    until delivery is assured)."""
+    out = run_scenario("send_then_close", 4)
+    assert all(o["got"] == 1 for o in out if o["rank"] != 0)
