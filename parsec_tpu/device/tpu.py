@@ -86,7 +86,24 @@ class TpuDevice(Device):
 
     def __init__(self, context, index):
         super().__init__(context, index)
-        self.jdev = jax.devices()[0]
+        # rank → chip binding: each rank's runtime drives its OWN device
+        # (reference: one CUDA module instance per visible GPU with
+        # per-rank visibility, device_gpu.c).  Only process-addressable
+        # devices qualify — jax.local_devices(), never the global list: on
+        # multi-host, jax.devices() includes chips other processes own and
+        # device_put onto them raises.  Ranks are laid out host-major
+        # (ranks r..r+k on one host), so rank % local-count is the local
+        # slot; tpu_device_index overrides for exotic layouts.
+        try:
+            devs = jax.local_devices()
+        except Exception:
+            devs = jax.devices()
+        pref = mca_param.register(
+            "device", "tpu_device_index", -1,
+            help="local JAX device index this rank binds "
+                 "(-1 = rank % local device count)")
+        jidx = pref if pref >= 0 else getattr(context, "rank", 0)
+        self.jdev = devs[jidx % len(devs)]
         # budget: prefer live PJRT stats, fall back to a conservative default
         budget = mca_param.register(
             "device", "tpu_hbm_budget_mb", 0,
@@ -267,7 +284,7 @@ class TpuDevice(Device):
                 dev_args.append(payload)
             elif kind == "scratch":
                 shape, dtype = payload
-                dev_args.append(jnp.zeros(shape, dtype))
+                dev_args.append(jax.device_put(jnp.zeros(shape, dtype), self.jdev))
             # other kinds (e.g. "ctl") contribute no argument
 
         key = getattr(body, "_jit_key", body)
@@ -301,7 +318,9 @@ class TpuDevice(Device):
         dtype = data.dtype if data.dtype is not None else getattr(newest.payload, "dtype", None)
         if shape is None or dtype is None:
             return self._stage_in(data)  # shape unknown: fall back
-        return jnp.zeros(shape, dtype)
+        # committed to THIS rank's device: an uncommitted zeros array
+        # would pull the computation onto the process default device
+        return jax.device_put(jnp.zeros(shape, dtype), self.jdev)
 
     def _stage_in(self, data: Data) -> Any:
         """Materialize the newest version of ``data`` on this device."""
