@@ -40,6 +40,12 @@ DONT_TRACK = AccessMode.DONT_TRACK
 CTL_MODE = AccessMode.CTL
 
 
+class _TileMap(dict):
+    """Plain dict, but weakref-able (builtin dict is not)."""
+
+    __slots__ = ("__weakref__",)
+
+
 class _Tile:
     __slots__ = ("last_writer", "readers", "_wr")
 
@@ -69,7 +75,7 @@ class NativeDTD:
             raise RuntimeError(
                 f"native core unavailable: {native.build_error()}")
         self._ng = native.NativeGraph()
-        self._tiles: Dict[int, _Tile] = {}
+        self._tiles: Dict[int, _Tile] = _TileMap()
         self._bodies: List[Optional[Callable[[], None]]] = []
         self._errors: List[BaseException] = []
         self._nthreads = max(1, nthreads)
@@ -113,14 +119,23 @@ class NativeDTD:
         """Tile state keyed by id(arr).  A weakref callback evicts the
         entry the moment the array dies, so a recycled id can never
         inherit a dead tile's last_writer/readers (and the dict stays
-        bounded by *live* tracked arrays, not arrays ever inserted)."""
+        bounded by *live* tracked arrays, not arrays ever inserted).
+        The callback captures the tile map WEAKLY — a strong ``self``
+        would keep the whole retired pool alive as long as any tracked
+        array lives."""
         key = id(arr)
         t = self._tiles.get(key)
         if t is None:
             t = self._tiles[key] = _Tile()
+            tiles_ref = weakref.ref(self._tiles)
+
+            def _evict(_r, k=key, m=tiles_ref):
+                d = m()
+                if d is not None:
+                    d.pop(k, None)
+
             try:
-                t._wr = weakref.ref(
-                    arr, lambda _r, k=key: self._tiles.pop(k, None))
+                t._wr = weakref.ref(arr, _evict)
             except TypeError:
                 t._wr = None  # non-weakreffable objects: caller keeps alive
         return t
