@@ -73,11 +73,14 @@ class CommEngine(Component):
         raise NotImplementedError
 
     # -- one-sided ------------------------------------------------------
-    def mem_register(self, handle: Any, buffer: Any, once: bool = False) -> None:
+    def mem_register(self, handle: Any, buffer: Any, once: bool = False,
+                     uses: Optional[int] = None) -> None:
         """Expose ``buffer`` for one-sided GETs under ``handle``. With
         ``once`` the registration is consumed by the first GET served —
         used for single-consumer transfers (e.g. DTD tile versions) so
-        epoch-keyed handles don't pin buffers forever."""
+        epoch-keyed handles don't pin buffers forever.  ``uses=N``
+        generalizes: the registration self-reclaims after serving N GETs
+        (activation payloads know their consumer count up front)."""
         raise NotImplementedError
 
     def mem_unregister(self, handle: Any) -> None:

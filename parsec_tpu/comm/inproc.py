@@ -45,7 +45,8 @@ class InprocFabric:
         self.nranks = nranks
         self.inboxes: List["queue.SimpleQueue"] = [queue.SimpleQueue() for _ in range(nranks)]
         self.mem: Dict[Any, Any] = {}
-        self.mem_once: set = set()
+        #: (rank, handle) -> remaining GETs before self-reclaim
+        self.mem_uses: Dict[Any, int] = {}
         self.mem_lock = threading.Lock()
         self._barrier = threading.Barrier(nranks)
         self.engines: List[Optional["InprocComm"]] = [None] * nranks
@@ -88,16 +89,21 @@ class InprocComm(CommEngine):
             peer.context._notify_work()
 
     # -- one-sided ------------------------------------------------------
-    def mem_register(self, handle: Any, buffer: Any, once: bool = False) -> None:
+    def mem_register(self, handle: Any, buffer: Any, once: bool = False,
+                     uses: Optional[int] = None) -> None:
+        if once:
+            uses = 1
         with self.fabric.mem_lock:
             self.fabric.mem[(self.rank, handle)] = buffer
-            if once:
-                self.fabric.mem_once.add((self.rank, handle))
+            if uses is not None:
+                self.fabric.mem_uses[(self.rank, handle)] = uses
+            else:
+                self.fabric.mem_uses.pop((self.rank, handle), None)
 
     def mem_unregister(self, handle: Any) -> None:
         with self.fabric.mem_lock:
             self.fabric.mem.pop((self.rank, handle), None)
-            self.fabric.mem_once.discard((self.rank, handle))
+            self.fabric.mem_uses.pop((self.rank, handle), None)
 
     def get(self, src_rank: int, handle: Any, on_done) -> None:
         """Emulated one-sided pull (the reference emulates put/get with AM
@@ -105,9 +111,13 @@ class InprocComm(CommEngine):
         memory)."""
         with self.fabric.mem_lock:
             buf = self.fabric.mem.get((src_rank, handle))
-            if (src_rank, handle) in self.fabric.mem_once:
-                self.fabric.mem.pop((src_rank, handle), None)
-                self.fabric.mem_once.discard((src_rank, handle))
+            uses = self.fabric.mem_uses.get((src_rank, handle))
+            if uses is not None:
+                if uses <= 1:
+                    self.fabric.mem.pop((src_rank, handle), None)
+                    self.fabric.mem_uses.pop((src_rank, handle), None)
+                else:
+                    self.fabric.mem_uses[(src_rank, handle)] = uses - 1
         if buf is None:
             raise KeyError(f"no registered memory {handle!r} at rank {src_rank}")
         self.stats["get_bytes"] += _payload_bytes(buf)

@@ -38,11 +38,12 @@ def _key_words(key) -> int:
 def _wire_len(msg: dict) -> int:
     """Logical activation-header length in bytes (reference
     ``remote_dep_wire_activate_t``: taskpool_id, task_class_id, locals,
-    output_mask packed as 32-bit words). Deterministic so trace-based
-    regression tests can pin exact byte sums (tests/profiling/
-    check-comms.py analog); inline payload bytes are accounted by the
-    DATA_PLD event, not here."""
-    return 4 * (4 + len(msg["src_locals"]) + len(msg["succ_locs"]))
+    output_mask packed as 32-bit words, plus 2 words per forward-set
+    entry — the rank/mask pairs this receiver re-propagates).
+    Deterministic so trace-based regression tests can pin exact byte sums
+    (tests/profiling/check-comms.py analog); inline payload bytes are
+    accounted by the DATA_PLD event, not here."""
+    return 4 * (4 + len(msg["src_locals"]) + 2 * len(msg.get("fwd", ())))
 
 
 class RemoteDepManager:
@@ -59,6 +60,15 @@ class RemoteDepManager:
         self.short_limit = mca_param.register(
             "runtime", "comm_short_limit", 1 << 16,
             help="payloads at or below this inline with activations (bytes)")
+        self.bcast_topo = str(mca_param.register(
+            "runtime", "bcast_topo", "binomial",
+            choices=["star", "chain", "binomial"],
+            help="activation fan-out topology: star | chain | binomial "
+                 "(reference remote_dep propagation trees)"))
+        if self.bcast_topo not in ("star", "chain", "binomial"):
+            debug.warning("remote_dep: unknown bcast_topo %r, using binomial",
+                          self.bcast_topo)
+            self.bcast_topo = "binomial"
         self.stats = collections.Counter()
         # register LAST: backends with a live comm thread may replay parked
         # activations synchronously from inside register_am
@@ -95,48 +105,116 @@ class RemoteDepManager:
         return tp
 
     # -- producer side ---------------------------------------------------
-    def send_activation(
+    def send_activations(
         self,
         tp,
         src_class: str,
         src_locals: Tuple,
-        flow_index: int,
-        payload: Optional[np.ndarray],
-        succ_class: str,
-        succ_locs: Tuple,
-        dst_rank: int,
+        rank_masks: Dict[int, int],
+        flow_payloads: Dict[int, np.ndarray],
     ) -> None:
-        """One successor activation. Inline payloads up to short_limit;
-        larger ones are registered for a one-sided GET."""
-        msg = {
-            "pool": tp.name,
-            "src_class": src_class,
-            "src_locals": src_locals,
-            "flow_index": flow_index,
-            "succ_class": succ_class,
-            "succ_locs": succ_locs,
-        }
-        if payload is None:
-            msg["kind"] = "ctl"
-        elif payload.nbytes <= self.short_limit:
-            msg["kind"] = "inline"
-            msg["data"] = payload
-            self.stats["inline_sent"] += 1
-        else:
-            handle = (tp.name, src_class, src_locals, flow_index)
-            self.ce.mem_register(handle, payload)
-            msg["kind"] = "get"
-            msg["handle"] = handle
-            self.stats["get_advertised"] += 1
-            if pins.active(pins.COMM_DATA_CTL):
-                pins.fire(pins.COMM_DATA_CTL, None,
-                          {"dst": dst_rank, "bytes": payload.nbytes})
-        self.stats["activations_sent"] += 1
-        if pins.active(pins.COMM_ACTIVATE):
-            pins.fire(pins.COMM_ACTIVATE, None,
-                      {"dst": dst_rank, "bytes": _wire_len(msg),
-                       "class": src_class})
-        self.ce.send_am(TAG_ACTIVATE, dst_rank, msg)
+        """Aggregated activations for ONE completing task: a single
+        message per destination rank carrying the output-flow mask for
+        every dep that rank participates in, with each flow's payload
+        shipped once (reference ``parsec_remote_deps_t`` +
+        ``remote_dep_wire_activate_t.output_mask``, remote_dep.h:132-153).
+
+        Destinations are covered down a broadcast topology (MCA
+        ``runtime_bcast_topo``: star | chain | binomial) with forward
+        sets: a receiver re-propagates to its subtree from its own copy,
+        so a 1→R fan-out costs the root O(children) payload sends and
+        O(log R) hops end-to-end under binomial instead of O(R) root
+        sends (reference remote_dep.c:262-345 propagation + fw_mask).
+
+        The receiver re-derives its local successors from (task, mask) —
+        the reference model (iterate_successors on the receiving rank) —
+        so successor lists never travel the wire."""
+        targets = sorted(rank_masks.items())
+        self._send_tree(tp.name, src_class, src_locals, targets, flow_payloads)
+
+    def _topo_children(
+            self, targets: List[Tuple[int, int]]
+    ) -> List[Tuple[Tuple[int, int], List[Tuple[int, int]]]]:
+        """Split ``[(rank, mask)...]`` into ``[(child, subtree)...]`` per
+        the configured topology.  binomial: each child takes the first
+        half of the remainder, halving recursively (log-depth, log root
+        fan-out); chain: one child carries everyone; star: all direct."""
+        # snapshot at init like short_limit — no registry lock on the
+        # send/forward hot path
+        topo = self.bcast_topo
+        if topo == "star":
+            return [(t, []) for t in targets]
+        if topo == "chain":
+            return [(targets[0], targets[1:])] if targets else []
+        out = []  # binomial
+        rest = list(targets)
+        while rest:
+            k = (len(rest) + 1) // 2  # child + its subtree
+            out.append((rest[0], rest[1:k]))
+            rest = rest[k:]
+        return out
+
+    def _send_tree(
+        self,
+        pool: str,
+        src_class: str,
+        src_locals: Tuple,
+        targets: List[Tuple[int, int]],
+        flow_payloads: Dict[int, np.ndarray],
+    ) -> None:
+        """Send one aggregated activation to each topology child, with its
+        subtree attached as the forward set (used by the producer AND by
+        every forwarding receiver — data follows the tree)."""
+        children = self._topo_children(targets)
+        if not children:
+            return
+        # above-short-limit payloads register ONCE with a GET budget equal
+        # to the number of children that will pull them, so registrations
+        # self-reclaim instead of pinning every large payload forever
+        needs: List[int] = []
+        get_counts: Dict[int, int] = {}
+        for (child, cmask), subtree in children:
+            need = cmask
+            for _r, m in subtree:
+                need |= m
+            needs.append(need)
+            for fi, payload in flow_payloads.items():
+                if (need >> fi) & 1 and payload.nbytes > self.short_limit:
+                    get_counts[fi] = get_counts.get(fi, 0) + 1
+        for fi, n in get_counts.items():
+            self.ce.mem_register((pool, src_class, src_locals, fi),
+                                 flow_payloads[fi], uses=n)
+        for ((child, cmask), subtree), need in zip(children, needs):
+            flows: Dict[int, dict] = {}
+            for fi, payload in flow_payloads.items():
+                if not (need >> fi) & 1:
+                    continue
+                if payload.nbytes <= self.short_limit:
+                    flows[fi] = {"kind": "inline", "data": payload}
+                    self.stats["inline_sent"] += 1
+                else:
+                    flows[fi] = {"kind": "get",
+                                 "handle": (pool, src_class, src_locals, fi),
+                                 "nbytes": payload.nbytes}
+                    self.stats["get_advertised"] += 1
+                    if pins.active(pins.COMM_DATA_CTL):
+                        pins.fire(pins.COMM_DATA_CTL, None,
+                                  {"dst": child, "bytes": payload.nbytes})
+            msg = {
+                "pool": pool,
+                "kind": "agg",
+                "src_class": src_class,
+                "src_locals": src_locals,
+                "mask": cmask,
+                "fwd": subtree,
+                "flows": flows,
+            }
+            self.stats["activations_sent"] += 1
+            if pins.active(pins.COMM_ACTIVATE):
+                pins.fire(pins.COMM_ACTIVATE, None,
+                          {"dst": child, "bytes": _wire_len(msg),
+                           "class": src_class})
+            self.ce.send_am(TAG_ACTIVATE, child, msg)
 
     def send_writeback(self, tp, collection_name: str, key: Tuple,
                        payload: Optional[np.ndarray], dst_rank: int) -> None:
@@ -171,29 +249,73 @@ class RemoteDepManager:
                                   msg["data"])
             return
         self.stats["activations_recv"] += 1
-        if kind == "get":
-            self.stats["get_issued"] += 1
-            self.ce.get(
-                src_rank, msg["handle"],
-                lambda buf: self._complete_incoming(tp, msg, buf))
-        elif kind == "inline":
-            self._complete_incoming(tp, msg, msg["data"])
-        else:  # ctl: no data
-            self._complete_incoming(tp, msg, None)
+        # aggregated activation: resolve every flow payload (inline now,
+        # GETs asynchronously), then forward down the tree and release
+        # local successors
+        flows: Dict[int, dict] = msg.get("flows", {})
+        resolved: Dict[int, np.ndarray] = {}
+        gets = [(fi, d) for fi, d in flows.items() if d["kind"] == "get"]
+        for fi, d in flows.items():
+            if d["kind"] == "inline":
+                resolved[fi] = d["data"]
+                if pins.active(pins.COMM_DATA_PLD):
+                    pins.fire(pins.COMM_DATA_PLD, None,
+                              {"bytes": d["data"].nbytes, "kind": "inline"})
+        if not gets:
+            self._complete_incoming(tp, msg, resolved)
+            return
+        remaining = [len(gets)]  # comm-thread-serial on TCP; lock-free ok
+        failed = [0]
 
-    def _complete_incoming(self, tp, msg: dict, buf: Optional[np.ndarray]) -> None:
-        """Deposit arrived data and release the successor locally
-        (reference remote_dep_release_incoming)."""
-        if buf is not None and pins.active(pins.COMM_DATA_PLD):
-            pins.fire(pins.COMM_DATA_PLD, None,
-                      {"bytes": buf.nbytes, "kind": msg["kind"]})
-        tp.incoming_remote_release(
+        def arrived(fi, buf):
+            if buf is None:
+                # GET failed (handle gone at the source): degrade, don't
+                # hang — only THIS flow's successors stall, everything
+                # else in the activation and the forward subtree proceeds
+                debug.error(
+                    "activation %s%r flow %d: payload GET failed; its "
+                    "successors will not be released",
+                    msg["src_class"], tuple(msg["src_locals"]), fi)
+                failed[0] |= 1 << fi
+            else:
+                resolved[fi] = buf
+                if pins.active(pins.COMM_DATA_PLD):
+                    pins.fire(pins.COMM_DATA_PLD, None,
+                              {"bytes": buf.nbytes, "kind": "get"})
+            remaining[0] -= 1
+            if remaining[0] == 0:
+                self._complete_incoming(tp, msg, resolved, failed[0])
+
+        for fi, d in gets:
+            self.stats["get_issued"] += 1
+            try:
+                self.ce.get(src_rank, d["handle"],
+                            lambda buf, fi=fi: arrived(fi, buf))
+            except Exception as e:  # inproc raises synchronously
+                debug.error("GET %r from %d raised: %s", d["handle"], src_rank, e)
+                arrived(fi, None)
+
+    def _complete_incoming(self, tp, msg: dict,
+                           resolved: Dict[int, np.ndarray],
+                           failed_mask: int = 0) -> None:
+        """All payloads in hand: re-propagate to this rank's subtree FIRST
+        (the tree must not wait on local execution — reference
+        remote_dep_propagate runs in the comm engine), then re-derive and
+        release local successors (reference remote_dep_release_incoming /
+        iterate_successors on the receiving rank).  Flows whose payload
+        was lost are masked OUT everywhere downstream: their successors
+        stay unreleased (loudly), the rest of the DAG keeps moving."""
+        fwd = [(r, m & ~failed_mask) for r, m in
+               (tuple(t) for t in msg.get("fwd", ()))]
+        if fwd:
+            self.stats["forwarded"] += 1
+            self._send_tree(msg["pool"], msg["src_class"],
+                            tuple(msg["src_locals"]), fwd, resolved)
+        tp.incoming_activation(
             src_class=msg["src_class"],
             src_locals=tuple(msg["src_locals"]),
-            flow_index=msg["flow_index"],
-            payload=buf,
-            succ_class=msg["succ_class"],
-            succ_locs=tuple(msg["succ_locs"]),
+            mask=msg["mask"] & ~failed_mask,
+            flow_data=resolved,
         )
 
     # -- DTD tile-version channel (shadow-task protocol) -----------------
@@ -236,6 +358,10 @@ class RemoteDepManager:
         key = tuple(msg["tile"]) if isinstance(msg["tile"], list) else msg["tile"]
 
         def arrived(buf):
+            if buf is None:  # failed GET (see _on_get_ans error path)
+                debug.error("dtd tile %r epoch %s: payload GET failed",
+                            key, msg["epoch"])
+                return
             if pins.active(pins.COMM_DATA_PLD):
                 pins.fire(pins.COMM_DATA_PLD, None,
                           {"bytes": buf.nbytes, "kind": msg["kind"]})

@@ -99,7 +99,7 @@ class TCPComm(CommEngine):
         self._am_lock = threading.Lock()
         self._unclaimed: Dict[int, List[Tuple[int, Any]]] = collections.defaultdict(list)
         self._mem: Dict[Any, Any] = {}
-        self._mem_once: set = set()
+        self._mem_uses: Dict[Any, int] = {}
         self._mem_lock = threading.Lock()
         self._pending_gets: Dict[int, Callable[[Any], None]] = {}
         self._get_seq = 0
@@ -225,24 +225,34 @@ class TCPComm(CommEngine):
             pass
 
     # -- one-sided (AM-handshake emulation) ------------------------------
-    def mem_register(self, handle: Any, buffer: Any, once: bool = False) -> None:
+    def mem_register(self, handle: Any, buffer: Any, once: bool = False,
+                     uses: Optional[int] = None) -> None:
+        if once:
+            uses = 1
         with self._mem_lock:
             self._mem[handle] = buffer
-            if once:
-                self._mem_once.add(handle)
+            if uses is not None:
+                self._mem_uses[handle] = uses
+            else:
+                self._mem_uses.pop(handle, None)
 
     def mem_unregister(self, handle: Any) -> None:
         with self._mem_lock:
             self._mem.pop(handle, None)
-            self._mem_once.discard(handle)
+            self._mem_uses.pop(handle, None)
 
     def _mem_take(self, handle: Any, default=None):
-        """Read a registered buffer; consume the registration if once."""
+        """Read a registered buffer; use-counted registrations self-reclaim
+        after their declared number of GETs."""
         with self._mem_lock:
             buf = self._mem.get(handle, default)
-            if handle in self._mem_once:
-                self._mem.pop(handle, None)
-                self._mem_once.discard(handle)
+            uses = self._mem_uses.get(handle)
+            if uses is not None:
+                if uses <= 1:
+                    self._mem.pop(handle, None)
+                    self._mem_uses.pop(handle, None)
+                else:
+                    self._mem_uses[handle] = uses - 1
         return buf
 
     def get(self, src_rank: int, handle: Any, on_done) -> None:
@@ -273,10 +283,12 @@ class TCPComm(CommEngine):
         if cb is None:
             return
         if "error" in msg:
-            # loud protocol error; the successor stays unreleased rather
-            # than silently running on absent data
+            # loud protocol error; the requester's callback is told (None)
+            # so an aggregated activation can degrade instead of hanging
+            # its whole forward subtree on one lost payload
             debug.error("rank %d: GET %s failed at rank %d: %s",
                         self.rank, msg["req"], src, msg["error"])
+            cb(None)
             return
         self.stats["get_bytes"] += getattr(msg["data"], "nbytes", 0)
         cb(msg["data"])

@@ -781,7 +781,11 @@ class PTGTaskpool(Taskpool):
             nb_consumers = 0
             myrank = self.context.rank if self.context else 0
             succ_list: List[Tuple[PTGTaskClass, Tuple]] = []
-            remote: List[Tuple[_PTGFlow, Optional[Data], PTGTaskClass, Tuple, int]] = []
+            # per-destination-rank output masks + one payload per flow:
+            # ONE aggregated activation per rank, however many successors
+            # live there (reference parsec_remote_deps_t, remote_dep.h:132)
+            rank_masks: Dict[int, int] = {}
+            flow_payloads: Dict[int, np.ndarray] = {}
             for f in pc.flows:
                 data = None
                 if f.mode != CTL and task.body_args is not None:
@@ -806,7 +810,12 @@ class PTGTaskpool(Taskpool):
                             continue
                         rank = succ_pc.rank_of(locs, self.constants)
                         if rank != myrank:
-                            remote.append((f, data, succ_pc, locs, rank))
+                            rank_masks[rank] = rank_masks.get(rank, 0) | (1 << f.index)
+                            if (f.mode != CTL and data is not None
+                                    and f.index not in flow_payloads):
+                                src = data.newest_copy()
+                                if src is not None:
+                                    flow_payloads[f.index] = np.asarray(src.payload)
                             continue
                         if f.mode != CTL:
                             if entry is None:
@@ -816,10 +825,17 @@ class PTGTaskpool(Taskpool):
                         succ_list.append((succ_pc, locs))
             if entry is not None:
                 repo.set_usage_limit(task.locals, nb_consumers)
-            # remote successors: activation messages over the comm engine
-            # (reference parsec_remote_dep_activate, SURVEY.md §3.4)
-            for f, data, succ_pc, locs, rank in remote:
-                self._remote_release(pc, task, f, data, succ_pc, locs, rank)
+            # remote successors: one aggregated activation per rank, routed
+            # down the broadcast topology (reference
+            # parsec_remote_dep_activate + propagate, SURVEY.md §3.4)
+            if rank_masks:
+                comm = self.context.comm if self.context else None
+                if comm is None:
+                    raise RuntimeError(
+                        f"task {task!r} has remote successors on ranks "
+                        f"{sorted(rank_masks)} but the context has no comm engine")
+                comm.remote_dep.send_activations(
+                    self, pc.name, task.locals, rank_masks, flow_payloads)
             ready: List[Task] = []
             for succ_pc, locs in succ_list:
                 became, _ = self.deps.release_counter(
@@ -900,55 +916,69 @@ class PTGTaskpool(Taskpool):
                                 n += 1
         return n
 
-    def _remote_release(
-        self,
-        pc: PTGTaskClass,
-        task: Task,
-        f: _PTGFlow,
-        data: Optional[Data],
-        succ_pc: PTGTaskClass,
-        locs: Tuple,
-        dst_rank: int,
-    ) -> None:
-        comm = self.context.comm if self.context else None
-        if comm is None:
-            raise RuntimeError(
-                f"task {task!r} has remote successor {succ_pc.name}{locs} on "
-                f"rank {dst_rank} but the context has no comm engine")
-        payload = None
-        if f.mode != CTL and data is not None:
-            src = data.newest_copy()
-            if src is not None:
-                payload = np.asarray(src.payload)
-        comm.remote_dep.send_activation(
-            self, pc.name, task.locals, f.index, payload,
-            succ_pc.name, locs, dst_rank)
-
-    def incoming_remote_release(
+    def incoming_activation(
         self,
         *,
         src_class: str,
         src_locals: Tuple,
-        flow_index: int,
-        payload,
-        succ_class: str,
-        succ_locs: Tuple,
+        mask: int,
+        flow_data: Dict[int, Any],
     ) -> None:
-        """Receiver half of the activation protocol (reference
-        ``remote_dep_release_incoming``): deposit the arrived flow data in
-        the producer's repo and decrement the successor's counter."""
-        if payload is not None:
-            repo = self.repos[src_class]
-            entry = repo.lookup_and_create(src_locals)
-            if entry.copies[flow_index] is None:
-                d = data_create((src_class, src_locals, flow_index), payload=payload)
-                entry.copies[flow_index] = d
-        succ_pc = self.ptg.classes[succ_class]
-        became, _ = self.deps.release_counter(
-            (succ_class, succ_locs), succ_pc.goal_of(succ_locs, self.constants))
-        if became and self.context is not None:
-            t = self._make_task(succ_pc, succ_locs)
-            self.context.schedule([t], es=self.context.current_es())
+        """Receiver half of the aggregated activation protocol (reference
+        ``remote_dep_release_incoming``): re-derive which of MY tasks the
+        masked output flows of ``(src_class, src_locals)`` release — the
+        reference model: the receiver runs iterate_successors itself, so
+        successor lists never travel the wire — deposit the arrived flow
+        payloads in the producer-class repo (usage-limited to the local
+        consumer count, like the local release path), and decrement
+        dependency counters.
+
+        Guards are re-evaluated HERE from (locals, constants); like the
+        reference, dynamic guards reading body-mutated state must be
+        rank-local or producer and consumer can disagree."""
+        pc = self.ptg.classes[src_class]
+        env = pc.env_of(src_locals, self.constants)
+        myrank = self.context.rank if self.context else 0
+        repo = self.repos[src_class]
+        entry = None
+        nb_consumers = 0
+        ready: List[Task] = []
+        for f in pc.flows:
+            if not (mask >> f.index) & 1:
+                continue
+            payload = flow_data.get(f.index)
+            deposited = False
+            for dep in f.deps_out:
+                t = dep.target(env)
+                if t is None or isinstance(t, (_NoneRef, _NewRef, _DataRef)):
+                    continue  # write-backs are the producer's business
+                succ_pc = self.ptg.classes[t.class_name]
+                for locs in _expand_args(t.args, env):
+                    if len(locs) != len(succ_pc.param_names):
+                        continue
+                    if not succ_pc.valid(locs, self.constants):
+                        continue
+                    if succ_pc.rank_of(locs, self.constants) != myrank:
+                        continue
+                    if f.mode != CTL and payload is not None:
+                        if not deposited:
+                            if entry is None:
+                                entry = repo.lookup_and_create(src_locals)
+                            if entry.copies[f.index] is None:
+                                entry.copies[f.index] = data_create(
+                                    (src_class, src_locals, f.index),
+                                    payload=payload)
+                            deposited = True
+                        nb_consumers += 1
+                    became, _ = self.deps.release_counter(
+                        (t.class_name, locs),
+                        succ_pc.goal_of(locs, self.constants))
+                    if became:
+                        ready.append(self._make_task(succ_pc, locs))
+        if entry is not None:
+            repo.set_usage_limit(src_locals, nb_consumers)
+        if ready and self.context is not None:
+            self.context.schedule(ready, es=self.context.current_es())
 
 
 # ---------------------------------------------------------------------------

@@ -57,10 +57,11 @@ def test_comm_trace_counts_large_payloads():
     ctl = df[df["name"] == "MPI_DATA_CTL"]
     pld = df[df["name"] == "MPI_DATA_PLD"]
 
-    # one activation per cross-rank dep, header length pinned:
-    # 4 * (4 words + 1 src local + 1 succ local) = 24 bytes each
+    # one AGGREGATED activation per (task, destination rank) — here one
+    # per src(f) — with the header length pinned: 4 * (4 words + 1 src
+    # local + 2*0 forward entries) = 20 bytes each
     assert len(act) == F
-    assert act["bytes"].sum() == F * 24
+    assert act["bytes"].sum() == F * 20
     # every payload above the short limit advertises exactly one GET
     assert len(ctl) == F
     # payload bytes delivered: exactly F * 2 MiB, all via the get path

@@ -1,0 +1,122 @@
+"""Aggregated activations + broadcast propagation topologies.
+
+Reference: one ``parsec_remote_deps_t`` per destination rank with an
+output mask covering all flows (remote_dep.h:132-153), and broadcast
+routing down star/chain/binomial trees with forward masks
+(remote_dep.c:262-345).  These tests PIN the comm counts: aggregation
+means one activation per (task, rank) and one payload per flow; binomial
+means O(log R) root payload sends for a 1->R fan-out.
+"""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl.ptg import PTG, IN, INOUT
+from parsec_tpu.utils import mca_param
+
+from test_multirank import run_ranks
+
+
+def test_activation_aggregation_one_message_per_rank():
+    """A task with TWO data flows fanning out to THREE successor tasks on
+    the same remote rank sends exactly ONE activation carrying both
+    payloads once (previously: 3 activations, 3 payload copies)."""
+    nranks = 2
+    got = {}
+
+    def build(rank, ctx):
+        dc = LocalCollection("D", shape=(4,), nodes=nranks, myrank=rank,
+                            init=lambda k: np.full(4, 1.0 + k))
+        dc.rank_of = lambda *key: 0 if key[0] < 2 else 1
+
+        ptg = PTG("agg")
+        src = ptg.task_class("src")
+        src.affinity("D(0)")
+        src.flow("X", INOUT, "<- D(0)", "-> X a(0)", "-> X b(0)")
+        src.flow("Y", INOUT, "<- D(1)", "-> Y a(0)")
+
+        def src_body(X, Y):
+            X += 10.0
+            Y += 20.0
+
+        src.body(cpu=src_body)
+
+        a = ptg.task_class("a", i="0 .. 0")
+        a.affinity("D(2)")
+        a.flow("X", IN, "<- X src()")
+        a.flow("Y", IN, "<- Y src()")
+        a.body(cpu=lambda X, Y, i: got.setdefault(
+            "a", (float(X[0]), float(Y[0]))))
+
+        b = ptg.task_class("b", i="0 .. 0")
+        b.affinity("D(3)")
+        b.flow("X", IN, "<- X src()")
+        b.body(cpu=lambda X, i: got.setdefault("b", float(X[0])))
+        return ptg.taskpool(D=dc)
+
+    ctxs = run_ranks(nranks, build, timeout=30)
+    assert got["a"] == (11.0, 22.0)
+    assert got["b"] == 11.0
+    rd0 = ctxs[0].comm.remote_dep
+    # ONE aggregated activation for the one remote rank...
+    assert rd0.stats["activations_sent"] == 1, dict(rd0.stats)
+    # ...carrying each flow's payload exactly once
+    assert rd0.stats["inline_sent"] == 2, dict(rd0.stats)
+    assert ctxs[1].comm.remote_dep.stats["activations_recv"] == 1
+
+
+@pytest.mark.parametrize("topo,root_sends,root_gets", [
+    ("star", 7, 7),
+    ("chain", 1, 1),
+    ("binomial", 3, 3),   # ceil(log2(8)) payload sends at the root
+])
+def test_broadcast_topology_counts(topo, root_sends, root_gets):
+    """1 -> R broadcast of an above-short-limit payload: under binomial
+    the root ships O(log R) copies and O(R) total hops cover all ranks;
+    under chain the root ships exactly one."""
+    nranks = 8
+    mca_param.set_param("runtime", "comm_short_limit", 64)
+    mca_param.set_param("runtime", "bcast_topo", topo)
+    try:
+        got = {r: [] for r in range(nranks)}
+
+        def build(rank, ctx):
+            dc = LocalCollection("D", shape=(256,), nodes=nranks, myrank=rank,
+                                init=lambda k: np.full(256, 7.0))
+            dc.rank_of = lambda *key: dc.data_key(*key) % nranks
+
+            ptg = PTG("bcast")
+            src = ptg.task_class("src")
+            src.affinity("D(0)")
+            src.flow("X", INOUT, "<- D(0)", "-> X sink(0 .. NR-1)")
+            src.body(cpu=lambda X: X.__iadd__(35.0))
+            sink = ptg.task_class("sink", r="0 .. NR-1")
+            sink.affinity("D(r)")
+            sink.flow("X", IN, "<- X src()")
+            sink.body(cpu=lambda X, r: got[rank].append(float(X[0])))
+            return ptg.taskpool(NR=nranks, D=dc)
+
+        ctxs = run_ranks(nranks, build, timeout=60)
+        for r in range(nranks):
+            assert got[r] == [42.0], (r, got)
+
+        rds = [c.comm.remote_dep for c in ctxs]
+        # exactly one activation reaches each non-root rank
+        for r in range(1, nranks):
+            assert rds[r].stats["activations_recv"] == 1, (r, dict(rds[r].stats))
+        # one activation per destination rank in TOTAL, however routed
+        assert sum(rd.stats["activations_sent"] for rd in rds) == nranks - 1
+        # the root's share is the topology's fan-out
+        assert rds[0].stats["activations_sent"] == root_sends, dict(rds[0].stats)
+        assert rds[0].stats["get_advertised"] == root_gets, dict(rds[0].stats)
+        # every rank pulled the payload exactly once, wherever from
+        assert sum(rd.stats["get_issued"] for rd in rds) == nranks - 1
+        # non-root forwarding only happens off-star
+        fwd = sum(rd.stats["forwarded"] for rd in rds)
+        assert (fwd == 0) if topo == "star" else (fwd > 0)
+        # use-counted registrations self-reclaimed: no payload pinned
+        assert not ctxs[0].comm.fabric.mem, ctxs[0].comm.fabric.mem
+    finally:
+        mca_param.params.unset("runtime", "comm_short_limit")
+        mca_param.params.unset("runtime", "bcast_topo")
