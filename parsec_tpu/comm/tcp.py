@@ -19,16 +19,27 @@ the host network, SURVEY.md §5.8):
 * rendezvous through a shared directory (each rank binds an ephemeral
   port and publishes ``<rank>.addr``) or an explicit ``peers`` list of
   ``host:port`` — the multi-host form;
-* frames are ``[u32 length | pickle((src, [(tag, payload), ...]))]`` —
-  a frame carries a *batch*: every AM queued for the same peer at drain
+* frames carry a *batch*: every AM queued for the same peer at drain
   time travels in one frame (the per-peer aggregation of the reference);
+* **datatype-described wire**: a frame is a small versioned header +
+  a pickled CONTROL structure + the raw bytes of every array payload
+  shipped OUT-OF-BAND (pickle protocol 5 buffers).  Sends are
+  zero-copy — array memory goes to the socket as memoryviews, never
+  copied into the pickle stream; non-contiguous arrays are gathered
+  through the datatype layer's ``pack`` (the CE pack/unpack slots,
+  reference ``parsec_comm_engine.h:176-199``).  Receives land payload
+  bytes DIRECTLY into recycled :class:`~parsec_tpu.data.arena.Arena`
+  buffers (``recv_into``, no intermediate bytes objects — reference
+  arena-backed receives, ``remote_dep_mpi.c:870-930``); delivered
+  arrays alias the arena slot, which self-releases when they die;
 * the comm thread dispatches AM callbacks directly (funnelled semantics:
   callbacks schedule work into the owning context's queues, exactly like
   the reference comm thread running ``release_deps``).
 
 Trust model: endpoints are the runtime's own cooperating processes
-(pickle on the wire, like MPI's trusted-cluster assumption); do not
-expose the rendezvous port to untrusted networks.
+(pickle for the control headers, like MPI's trusted-cluster assumption);
+frames are magic/version-checked and size-capped, but do not expose the
+rendezvous port to untrusted networks.
 """
 
 from __future__ import annotations
@@ -42,9 +53,12 @@ import socket
 import struct
 import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ..utils import debug, register_component
+import numpy as np
+
+from ..utils import debug, mca_param, register_component
 from .engine import CommEngine, MAX_AM_TAGS
 
 # internal tag space (reference registers internal GET/PUT AM tags at init,
@@ -54,9 +68,20 @@ TAG_BARRIER = MAX_AM_TAGS - 3     # 9
 TAG_GET_REQ = MAX_AM_TAGS - 2     # 10
 TAG_GET_ANS = MAX_AM_TAGS - 1     # 11
 
-_LEN = struct.Struct("!I")
+#: frame header: magic, wire version, control-blob bytes, out-of-band
+#: buffer count; then ``nbufs`` u64 buffer lengths, the control pickle,
+#: and the raw array bytes
+_HDR = struct.Struct("!HHII")
+_BUFLEN = struct.Struct("!Q")
+_MAGIC = 0x9A7C
+_WIRE_VERSION = 2
 _RANK = struct.Struct("!i")
 _MISSING = object()
+
+try:  # numpy >= 2.0
+    from numpy.lib.array_utils import byte_bounds as _byte_bounds
+except ImportError:  # pragma: no cover - numpy 1.x
+    _byte_bounds = np.byte_bounds
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -67,6 +92,82 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
             raise ConnectionError("peer closed")
         buf += chunk
     return bytes(buf)
+
+
+def _pack_arrays(obj: Any, stats) -> Any:
+    """Route every non-contiguous ndarray through the datatype layer's
+    ``pack`` (gather to wire-contiguous form) so pickle-5 can ship ALL
+    array payloads out-of-band as zero-copy buffers; contiguous arrays
+    pass through untouched."""
+    if isinstance(obj, np.ndarray):
+        if obj.flags.c_contiguous or obj.flags.f_contiguous:
+            return obj
+        stats["dt_packed"] += 1
+        base = obj.base
+        if (obj.ndim == 2 and obj.strides[1] == obj.itemsize
+                and isinstance(base, np.ndarray) and base.flags.c_contiguous):
+            # a strided row panel (LAPACK tile view): describe it as a
+            # Vector over its base buffer and gather via the datatype
+            # layer's pack — the CE pack slot exercised on the real wire.
+            # reshape(-1) on a contiguous base is a VIEW (same pointer),
+            # so the element-offset arithmetic below is exact; anything
+            # misaligned (sub-itemsize byte offset) falls through to the
+            # plain gather rather than shipping shifted bytes.
+            from ..data.datatype import type_of_array
+
+            try:
+                flat = base.reshape(-1)
+                if flat.dtype != obj.dtype:
+                    flat = flat.view(obj.dtype)
+                delta = (obj.__array_interface__["data"][0]
+                         - flat.__array_interface__["data"][0])
+                if delta >= 0 and delta % obj.itemsize == 0:
+                    dt = type_of_array(obj)
+                    return dt.pack(flat, delta // obj.itemsize).reshape(obj.shape)
+            except (ValueError, TypeError):
+                pass
+        return np.ascontiguousarray(obj)
+    if isinstance(obj, dict):
+        return {k: _pack_arrays(v, stats) for k, v in obj.items()}
+    if isinstance(obj, tuple):
+        return tuple(_pack_arrays(v, stats) for v in obj)
+    if isinstance(obj, list):
+        return [_pack_arrays(v, stats) for v in obj]
+    return obj
+
+
+def _walk_arrays(obj: Any, out: List[np.ndarray]) -> None:
+    if isinstance(obj, np.ndarray):
+        out.append(obj)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            _walk_arrays(v, out)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            _walk_arrays(v, out)
+
+
+class _RecvState:
+    """Per-peer streaming frame parser: header → buffer-length table →
+    control blob → payload buffers, each phase filled by ``recv_into``
+    with payloads landing straight in arena slots."""
+
+    __slots__ = ("phase", "target", "got", "ctl_len", "ctl", "nbufs",
+                 "lens", "bufs", "bufi")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.phase = "hdr"
+        self.target = memoryview(bytearray(_HDR.size))
+        self.got = 0
+        self.ctl_len = 0
+        self.ctl = b""
+        self.nbufs = 0
+        self.lens: List[int] = []
+        self.bufs: List[Any] = []   # DataCopy per payload (arena slots)
+        self.bufi = 0
 
 
 @register_component("comm")
@@ -122,7 +223,14 @@ class TCPComm(CommEngine):
         self._barrier_cv = threading.Condition()
 
         self._socks: Dict[int, socket.socket] = {}
-        self._rdbuf: Dict[int, bytearray] = {}
+        #: per-peer streaming frame parsers (recv_into arena slots)
+        self._rx: Dict[int, _RecvState] = {}
+        #: receive arenas by power-of-two size class
+        self._rx_arenas: Dict[int, Any] = {}
+        self.max_frame = mca_param.register(
+            "runtime", "comm_max_frame", 1 << 31,
+            help="per-frame cap (bytes) on control blob / payload total; "
+                 "larger frames drop the connection as corrupt")
         if nranks > 1:
             self._bootstrap(rendezvous_dir, peers, host, connect_timeout)
 
@@ -197,7 +305,7 @@ class TCPComm(CommEngine):
         lsock.close()
         for s in self._socks.values():
             s.setblocking(False)
-        self._rdbuf = {r: bytearray() for r in self._socks}
+        self._rx = {r: _RecvState() for r in self._socks}
 
     # -- AM --------------------------------------------------------------
     def register_am(self, tag: int, cb) -> None:
@@ -392,31 +500,63 @@ class TCPComm(CommEngine):
                 break
             batches[dst].append((tag, payload))
             n += 1
-        for dst, batch in batches.items():
-            blob = pickle.dumps((self.rank, batch), protocol=5)
-            self.stats["am_bytes"] += len(blob)
-            self.stats["frames_sent"] += 1
-            sock = self._socks.get(dst)
-            if sock is None:
-                debug.error("rank %d: no route to rank %d", self.rank, dst)
-                continue
-            try:
-                # byte-tracked send: sendall on a non-blocking socket can
-                # transmit part of the frame before raising, with no way to
-                # learn how much — that would corrupt the length-prefixed
-                # stream on retry, so every send goes through the tracker
-                self._send_tracked(sock, _LEN.pack(len(blob)) + blob)
-            except OSError as e:
-                if not self._closing.is_set():
-                    debug.error("rank %d: send to %d failed: %s", self.rank, dst, e)
-                else:
-                    # close-phase sends (barrier releases, FIN) are
-                    # load-bearing for the handshake: a failure here is why
-                    # a peer would later report a missing FIN
-                    debug.verbose(1, "comm",
-                                  "rank %d: close-phase send to %d failed: %s",
-                                  self.rank, dst, e)
+        for dst, whole in batches.items():
+            for batch in self._frame_chunks(whole):
+                self._send_frame(dst, batch)
         return n
+
+    def _frame_chunks(self, batch: List[Tuple[int, Any]]):
+        """Split a peer's batch so each frame respects the receiver's
+        comm_max_frame payload cap (an aggregated drain can legitimately
+        exceed it; the receiver treats oversize as corruption)."""
+        cap = max(1 << 20, self.max_frame // 2)
+        chunk, weight = [], 0
+        for item in batch:
+            arrs: List[np.ndarray] = []
+            _walk_arrays(item[1], arrs)
+            w = sum(a.nbytes for a in arrs)
+            if chunk and (weight + w > cap or len(chunk) >= 16384):
+                yield chunk
+                chunk, weight = [], 0
+            chunk.append(item)
+            weight += w
+        if chunk:
+            yield chunk
+
+    def _send_frame(self, dst: int, batch: List[Tuple[int, Any]]) -> None:
+        # control structure pickles; array payloads ship out-of-band
+        # as raw zero-copy memoryviews appended after the blob
+        bufs: List[memoryview] = []
+        blob = pickle.dumps(
+            (self.rank, _pack_arrays(batch, self.stats)),
+            protocol=5,
+            buffer_callback=lambda pb: bufs.append(pb.raw()) and None)
+        head = (_HDR.pack(_MAGIC, _WIRE_VERSION, len(blob), len(bufs))
+                + b"".join(_BUFLEN.pack(b.nbytes) for b in bufs) + blob)
+        self.stats["am_bytes"] += len(head) + sum(b.nbytes for b in bufs)
+        self.stats["frames_sent"] += 1
+        sock = self._socks.get(dst)
+        if sock is None:
+            debug.error("rank %d: no route to rank %d", self.rank, dst)
+            return
+        try:
+            # byte-tracked sends: sendall on a non-blocking socket can
+            # transmit part of the frame before raising, with no way to
+            # learn how much — that would corrupt the framed stream on
+            # retry, so every segment goes through the tracker
+            self._send_tracked(sock, head)
+            for b in bufs:
+                self._send_tracked(sock, b)
+        except OSError as e:
+            if not self._closing.is_set():
+                debug.error("rank %d: send to %d failed: %s", self.rank, dst, e)
+            else:
+                # close-phase sends (barrier releases, FIN) are
+                # load-bearing for the handshake: a failure here is why
+                # a peer would later report a missing FIN
+                debug.verbose(1, "comm",
+                              "rank %d: close-phase send to %d failed: %s",
+                              self.rank, dst, e)
 
     def _send_tracked(self, sock: socket.socket, data: bytes) -> None:
         """Write the whole frame or raise.  Deliberately does NOT abort on
@@ -460,30 +600,188 @@ class TCPComm(CommEngine):
             peer = next((r for r, s in self._socks.items() if s is sock), None)
             if peer is None:
                 continue
-            try:
-                data = sock.recv(1 << 20)
-            except (BlockingIOError, InterruptedError):
-                continue
-            except OSError:
-                data = b""
-            if not data:
-                if not self._closing.is_set():
-                    debug.verbose(2, "comm", "rank %d: peer %d closed", self.rank, peer)
-                self._socks.pop(peer, None)
-                continue
-            buf = self._rdbuf[peer]
-            buf += data
-            while len(buf) >= _LEN.size:
-                (length,) = _LEN.unpack_from(buf, 0)
-                if len(buf) < _LEN.size + length:
-                    break
-                blob = bytes(buf[_LEN.size:_LEN.size + length])
-                del buf[:_LEN.size + length]
-                src, batch = pickle.loads(blob)
-                for tag, payload in batch:
-                    self._dispatch(tag, src, payload)
-                    n += 1
+            n += self._pump_peer(peer, sock)
         return n
+
+    def _pump_peer(self, peer: int, sock: socket.socket) -> int:
+        """Advance peer's frame parser with whatever bytes are available
+        (bounded per call so one fast peer can't starve the rest).
+        Payload phases recv_into arena slots directly — network bytes land
+        in recycled buffers, never in intermediate bytes objects."""
+        st = self._rx[peer]
+        n = 0
+        budget = 16 << 20
+        while budget > 0:
+            if st.got == len(st.target):
+                # zero-length phase (empty ndarray payload): nothing to
+                # read — advance directly, recv_into on an empty view
+                # would return 0 and be mistaken for EOF
+                n += self._rx_advance(peer, st)
+                continue
+            try:
+                got = sock.recv_into(st.target[st.got:])
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                got = 0
+            if got == 0:
+                if not self._closing.is_set():
+                    debug.verbose(2, "comm", "rank %d: peer %d closed",
+                                  self.rank, peer)
+                self._rx_abort(st)
+                self._socks.pop(peer, None)
+                break
+            st.got += got
+            budget -= got
+            if st.got < len(st.target):
+                continue
+            n += self._rx_advance(peer, st)
+        return n
+
+    def _rx_advance(self, peer: int, st: _RecvState) -> int:
+        """One parser phase filled; step the state machine.  Returns the
+        number of AMs delivered (only the final phase delivers)."""
+        if st.phase == "hdr":
+            magic, ver, ctl_len, nbufs = _HDR.unpack(st.target)
+            if magic != _MAGIC or ver != _WIRE_VERSION:
+                debug.error("rank %d: bad frame from %d (magic=%#x ver=%d) — "
+                            "dropping connection", self.rank, peer, magic, ver)
+                self._drop_peer(peer, st)
+                return 0
+            if ctl_len > self.max_frame or nbufs > 65536:
+                debug.error("rank %d: oversized frame from %d (ctl=%d nbufs=%d)"
+                            " — dropping connection", self.rank, peer, ctl_len, nbufs)
+                self._drop_peer(peer, st)
+                return 0
+            st.ctl_len, st.nbufs = ctl_len, nbufs
+            st.phase = "lens"
+            st.target = memoryview(bytearray(_BUFLEN.size * nbufs)) \
+                if nbufs else st.target
+            st.got = 0
+            if nbufs == 0:
+                st.lens = []
+                st.phase = "ctl"
+                st.target = memoryview(bytearray(st.ctl_len))
+            return 0
+        if st.phase == "lens":
+            st.lens = [_BUFLEN.unpack_from(st.target, i * _BUFLEN.size)[0]
+                       for i in range(st.nbufs)]
+            if sum(st.lens) > self.max_frame:
+                debug.error("rank %d: oversized payload from %d (%d bytes) — "
+                            "dropping connection", self.rank, peer, sum(st.lens))
+                self._drop_peer(peer, st)
+                return 0
+            st.phase = "ctl"
+            st.target = memoryview(bytearray(st.ctl_len))
+            st.got = 0
+            return 0
+        if st.phase == "ctl":
+            st.ctl = bytes(st.target)
+            st.bufs, st.bufi = [], 0
+            return self._rx_next_buf(peer, st)
+        # payload buffer st.bufi filled
+        st.bufi += 1
+        return self._rx_next_buf(peer, st)
+
+    def _rx_next_buf(self, peer: int, st: _RecvState) -> int:
+        if st.bufi < st.nbufs:
+            copy = self._rx_alloc(st.lens[st.bufi])
+            st.bufs.append(copy)
+            st.phase = "buf"
+            st.target = memoryview(copy.payload)[:st.lens[st.bufi]]
+            st.got = 0
+            return 0
+        delivered = self._rx_deliver(st)
+        st.reset()
+        return delivered
+
+    def _rx_alloc(self, nbytes: int):
+        """Arena slot for an incoming payload: power-of-two size classes
+        of raw bytes, recycled across frames (reference arena-backed
+        receives)."""
+        from ..data.arena import Arena
+
+        k = max(9, int(nbytes - 1).bit_length()) if nbytes > 1 else 9
+        ar = self._rx_arenas.get(k)
+        if ar is None:
+            ar = self._rx_arenas[k] = Arena((1 << k,), np.uint8,
+                                            name=f"rx-{1 << k}")
+            # receives must always land (backpressure is TCP's job): the
+            # global arena_max_used cap would make allocate() return None
+            # and kill the comm thread mid-frame
+            ar.max_used = 0
+        return ar.allocate()
+
+    def _rx_deliver(self, st: _RecvState) -> int:
+        """Frame complete: rebuild the batch with arrays aliasing the
+        arena slots, arm per-slot release-on-death, dispatch."""
+        views = [memoryview(c.payload)[:ln]
+                 for c, ln in zip(st.bufs, st.lens)]
+        try:
+            src, batch = pickle.loads(st.ctl, buffers=views)
+        except Exception as e:
+            debug.error("rank %d: undecodable frame: %s", self.rank, e)
+            for c in st.bufs:
+                c.arena.release(c)
+            return 0
+        self._rx_retire(st.bufs, st.lens, batch)
+        n = 0
+        for tag, payload in batch:
+            self._dispatch(tag, src, payload)
+            n += 1
+        return n
+
+    def _rx_retire(self, bufs, lens, batch) -> None:
+        """Arena slots stay checked out while any delivered array aliases
+        them (a finalizer returns the slot when the LAST aliasing array
+        dies); unreferenced slots recycle immediately."""
+        if not bufs:
+            return
+        arrs: List[np.ndarray] = []
+        _walk_arrays(batch, arrs)
+        spans = []
+        for arr in arrs:
+            try:
+                spans.append(_byte_bounds(arr))
+            except Exception:
+                spans.append((0, 0))
+        for c in bufs:
+            blo, bhi = _byte_bounds(c.payload)
+            holders = [a for a, (lo, hi) in zip(arrs, spans)
+                       if lo >= blo and hi <= bhi and a.nbytes > 0]
+            if not holders:
+                c.arena.release(c)
+                continue
+            pending = [len(holders)]
+
+            def _release(_r=None, c=c, pending=pending):
+                pending[0] -= 1
+                if pending[0] == 0:
+                    c.arena.release(c)
+
+            for a in holders:
+                try:
+                    weakref.finalize(a, _release)
+                except TypeError:  # pragma: no cover
+                    _release()
+
+    def _rx_abort(self, st: _RecvState) -> None:
+        """Mid-frame EOF/teardown: recycle any half-filled arena slots."""
+        for c in st.bufs:
+            try:
+                c.arena.release(c)
+            except Exception:
+                pass
+        st.reset()
+
+    def _drop_peer(self, peer: int, st: _RecvState) -> None:
+        self._rx_abort(st)
+        s = self._socks.pop(peer, None)
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
 
     def _dispatch(self, tag: int, src: int, payload: Any) -> None:
         with self._am_lock:

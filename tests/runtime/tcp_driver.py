@@ -273,5 +273,84 @@ def scenario_send_then_close(ce):
     return {"got": len(got)}
 
 
+
+
+def scenario_perf(ce):
+    """RTT + bandwidth through the real AM path (reference
+    tests/apps/pingpong rtt.jdf / bandwidth.jdf): rank 0 <-> rank 1,
+    small-payload round trips, then large one-way transfers with a
+    final ack.  Rank 1 echoes from inside the AM callback (comm-thread
+    turnaround, no scheduler in the loop)."""
+    TRIPS, REPS = 200, 30
+    got = []
+    if ce.rank == 1:
+        def echo(src, p):
+            if "seq" in p:
+                ce.send_am(TAG_USER_BASE, 0, {"ack": p["seq"]})
+            elif p.get("last"):
+                ce.send_am(TAG_USER_BASE, 0, {"done": True})
+        ce.register_am(TAG_USER_BASE, echo)
+    else:
+        ce.register_am(TAG_USER_BASE, lambda src, p: got.append(p))
+    ce.barrier()
+    out = {}
+    if ce.rank == 0:
+        t0 = time.perf_counter()
+        for i in range(TRIPS):
+            ce.send_am(TAG_USER_BASE, 1, {"seq": i})
+            while len(got) <= i:
+                time.sleep(0)
+        rtt_us = (time.perf_counter() - t0) / TRIPS * 1e6
+        got.clear()
+        arr = np.arange(1 << 20, dtype=np.float64)  # 8 MiB
+        t0 = time.perf_counter()
+        for i in range(REPS):
+            ce.send_am(TAG_USER_BASE, 1, {"blk": arr, "last": i == REPS - 1})
+        while not got:
+            time.sleep(0)
+        dt = time.perf_counter() - t0
+        out = {"rtt_us": round(rtt_us, 1),
+               "mb_s": round(REPS * arr.nbytes / dt / 1e6, 1)}
+    ce.barrier()
+    return out
+
+
+
+
+def scenario_bcast(ce):
+    """1 -> R broadcast of an above-short-limit payload over the real
+    wire, topology from PARSEC_MCA_runtime_bcast_topo: pins that
+    aggregation + forward sets behave identically over TCP (async GETs,
+    forwarding from inside GET callbacks) as over the test fabric."""
+    got = []
+    ctx = Context(nb_cores=2, rank=ce.rank, nranks=ce.nranks, comm=ce)
+    dc = LocalCollection("D", shape=(65536,), nodes=ce.nranks, myrank=ce.rank,
+                         init=lambda k: np.full(65536, 7.0))
+    dc.rank_of = lambda *key: dc.data_key(*key) % ce.nranks
+
+    ptg = PTG("bcast")
+    src = ptg.task_class("src")
+    src.affinity("D(0)")
+    src.flow("X", INOUT, "<- D(0)", "-> X sink(0 .. NR-1)")
+    src.body(cpu=lambda X: X.__iadd__(35.0))
+    sink = ptg.task_class("sink", r="0 .. NR-1")
+    sink.affinity("D(r)")
+    sink.flow("X", IN, "<- X src()")
+    sink.body(cpu=lambda X, r: got.append(float(X[0])))
+    tp = ptg.taskpool(NR=ce.nranks, D=dc)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=90)
+    assert got == [42.0], got
+    ce.barrier()
+    st = ce.remote_dep.stats
+    out = {"sent": int(st["activations_sent"]),
+           "recv": int(st["activations_recv"]),
+           "fwd": int(st["forwarded"]),
+           "get_adv": int(st["get_advertised"]),
+           "mem_left": len(ce._mem)}
+    ctx.fini()
+    return out
+
+
 if __name__ == "__main__":
     main()

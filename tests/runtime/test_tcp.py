@@ -16,9 +16,9 @@ from parsec_tpu.comm.launch import launch
 DRIVER = os.path.join(os.path.dirname(__file__), "tcp_driver.py")
 
 
-def run_scenario(name, nranks, timeout=180):
+def run_scenario(name, nranks, timeout=180, extra_env=None):
     results = launch(nranks, [DRIVER, name], timeout=timeout,
-                     env={"JAX_PLATFORMS": "cpu"})
+                     env={"JAX_PLATFORMS": "cpu", **(extra_env or {})})
     out = []
     for r in results:
         line = r.stdout.strip().splitlines()[-1]
@@ -81,3 +81,34 @@ def test_tcp_send_then_immediate_close():
     until delivery is assured)."""
     out = run_scenario("send_then_close", 4)
     assert all(o["got"] == 1 for o in out if o["rank"] != 0)
+
+
+def test_tcp_perf_smoke():
+    """RTT/bandwidth through the real AM path (rtt.jdf/bandwidth.jdf
+    shape). Not pinned — loose sanity floors; the measured numbers land
+    in BASELINE.md."""
+    out = run_scenario("perf", 2)
+    r0 = next(o for o in out if o["rank"] == 0)
+    print(f"\ntcp perf: rtt={r0['rtt_us']} us, bw={r0['mb_s']} MB/s")
+    assert r0["rtt_us"] < 50000
+    assert r0["mb_s"] > 100
+
+
+@pytest.mark.parametrize("topo,root_sends", [
+    ("star", 7), ("chain", 1), ("binomial", 3),
+])
+def test_tcp_broadcast_topologies(topo, root_sends):
+    """The test_bcast.py pins, re-run over REAL TCP processes: async GET
+    payload pulls and tree forwarding from inside GET callbacks."""
+    out = run_scenario("bcast", 8, timeout=240,
+                       extra_env={"PARSEC_MCA_runtime_bcast_topo": topo,
+                                  "PARSEC_MCA_runtime_comm_short_limit": "1024"})
+    by_rank = {o["rank"]: o for o in out}
+    assert sum(o["sent"] for o in out) == 7
+    assert by_rank[0]["sent"] == root_sends
+    assert by_rank[0]["get_adv"] == root_sends
+    for r in range(1, 8):
+        assert by_rank[r]["recv"] == 1
+    assert all(o["mem_left"] == 0 for o in out)
+    fwd = sum(o["fwd"] for o in out)
+    assert (fwd == 0) if topo == "star" else (fwd > 0)

@@ -1,0 +1,144 @@
+"""Binary wire format: out-of-band array framing, datatype-packed sends,
+arena-backed receives (reference: CE pack/unpack slots
+parsec_comm_engine.h:176-199 + arena receives remote_dep_mpi.c:870-930).
+
+Two real TCPComm endpoints inside one process (loopback sockets, separate
+comm threads) so frame internals are observable from both sides.
+"""
+
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm.engine import TAG_USER_BASE
+from parsec_tpu.comm.tcp import TCPComm
+
+
+def _pair():
+    rdv = tempfile.mkdtemp()
+    ces = [None, None]
+
+    def mk(r):
+        ces[r] = TCPComm(r, 2, rendezvous_dir=rdv)
+
+    ts = [threading.Thread(target=mk, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return ces
+
+
+def _close_all(ces):
+    ts = [threading.Thread(target=ce.close) for ce in ces]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+
+def _wait(pred, timeout=20):
+    deadline = time.time() + timeout
+    while not pred():
+        time.sleep(0.005)
+        assert time.time() < deadline, "timed out"
+
+
+def test_wire_arrays_out_of_band_and_arena_recv():
+    """Array payloads ship as raw out-of-band bytes (zero-copy on send)
+    and land in arena slots on receive; slots recycle once the delivered
+    arrays die."""
+    ces = _pair()
+    try:
+        import gc
+
+        got = []
+        ces[1].register_am(TAG_USER_BASE, lambda src, p: got.append(p))
+        big = np.arange(32768.0)  # 256 KiB: far beyond inline pickling
+
+        def send_and_check(lo, hi):
+            ces[0].send_am(TAG_USER_BASE, 1,
+                           [{"i": i, "arr": big * i} for i in range(lo, hi)])
+            _wait(lambda: got)
+            for i, p in zip(range(lo, hi), got[0]):
+                assert p["i"] == i
+                np.testing.assert_allclose(p["arr"], big * i)
+            got.clear()
+
+        send_and_check(0, 4)
+        # frames carried out-of-band buffers, receiver used arena slots
+        assert ces[0].stats["frames_sent"] >= 1
+        assert ces[1]._rx_arenas, "no receive arenas were created"
+        created1 = sum(a.stats()["created"]
+                       for a in ces[1]._rx_arenas.values())
+        assert created1 > 0
+        # drop the delivered arrays: their arena slots must come home
+        gc.collect()
+        _wait(lambda: all(a.stats()["used"] == 0
+                          for a in ces[1]._rx_arenas.values()))
+        # a second round reuses the recycled slots instead of allocating
+        send_and_check(4, 8)
+        gc.collect()
+        _wait(lambda: all(a.stats()["used"] == 0
+                          for a in ces[1]._rx_arenas.values()))
+        created2 = sum(a.stats()["created"]
+                       for a in ces[1]._rx_arenas.values())
+        assert created2 == created1, f"no recycling: {created1} -> {created2}"
+    finally:
+        _close_all(ces)
+
+
+def test_wire_noncontiguous_payload_packs_via_datatype():
+    """A strided tile view (LAPACK panel shape) is gathered through the
+    datatype layer's Vector.pack on send and arrives value-correct."""
+    ces = _pair()
+    try:
+        got = []
+        ces[1].register_am(TAG_USER_BASE, lambda src, p: got.append(p))
+        base = np.arange(64.0 * 64).reshape(64, 64)
+        tile = base[8:24, 4:20]  # non-contiguous 16x16 view
+        assert not tile.flags.c_contiguous
+        ces[0].send_am(TAG_USER_BASE, 1, {"tile": tile})
+        _wait(lambda: got)
+        np.testing.assert_allclose(got[0]["tile"], tile)
+        assert ces[0].stats["dt_packed"] >= 1
+    finally:
+        _close_all(ces)
+
+
+def test_wire_rejects_oversized_frames():
+    """comm_max_frame caps payload totals: an oversized frame drops the
+    connection instead of allocating unbounded memory."""
+    from parsec_tpu.utils import mca_param
+
+    ces = _pair()
+    try:
+        ces[1].max_frame = 1024  # receiver-side cap
+        got = []
+        ces[1].register_am(TAG_USER_BASE, lambda src, p: got.append(p))
+        ces[0].send_am(TAG_USER_BASE, 1, {"arr": np.zeros(65536)})
+        _wait(lambda: 0 not in ces[1]._socks, timeout=10)
+        assert not got
+    finally:
+        _close_all(ces)
+
+
+def test_wire_empty_array_payload():
+    """Regression: a zero-length ndarray pickles to a 0-byte out-of-band
+    buffer; the receiver must not mistake the empty recv for peer EOF
+    (that dropped the whole connection)."""
+    ces = _pair()
+    try:
+        got = []
+        ces[1].register_am(TAG_USER_BASE, lambda src, p: got.append(p))
+        ces[0].send_am(TAG_USER_BASE, 1,
+                       {"empty": np.empty(0), "arr": np.arange(4.0)})
+        _wait(lambda: got)
+        assert got[0]["empty"].size == 0
+        np.testing.assert_allclose(got[0]["arr"], np.arange(4.0))
+        assert 0 in ces[1]._socks  # connection survived
+    finally:
+        _close_all(ces)
