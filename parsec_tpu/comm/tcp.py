@@ -507,19 +507,33 @@ class TCPComm(CommEngine):
 
     def _frame_chunks(self, batch: List[Tuple[int, Any]]):
         """Split a peer's batch so each frame respects the receiver's
-        comm_max_frame payload cap (an aggregated drain can legitimately
-        exceed it; the receiver treats oversize as corruption)."""
+        limits — the comm_max_frame payload cap AND the 65536
+        out-of-band buffer cap (an aggregated drain can legitimately
+        exceed both; the receiver treats oversize as corruption).  The
+        weights are a walk over dict/list/tuple payloads; arrays nested
+        in custom objects ship fine (pickle-5 finds them) but weigh 0
+        here, so keep protocol payloads in plain containers.  NOTE: the
+        caps are protocol constants — comm_max_frame must agree across
+        ranks (it is an MCA param; set it identically everywhere)."""
         cap = max(1 << 20, self.max_frame // 2)
-        chunk, weight = [], 0
+        chunk, weight, nbufs = [], 0, 0
         for item in batch:
             arrs: List[np.ndarray] = []
             _walk_arrays(item[1], arrs)
             w = sum(a.nbytes for a in arrs)
-            if chunk and (weight + w > cap or len(chunk) >= 16384):
+            if chunk and (weight + w > cap or len(chunk) >= 16384
+                          or nbufs + len(arrs) > 32768):
                 yield chunk
-                chunk, weight = [], 0
+                chunk, weight, nbufs = [], 0, 0
+            if w > self.max_frame:
+                debug.error(
+                    "rank %d: single AM payload (%d bytes) exceeds "
+                    "comm_max_frame (%d) — the receiver will drop the "
+                    "connection; raise the runtime_comm_max_frame param",
+                    self.rank, w, self.max_frame)
             chunk.append(item)
             weight += w
+            nbufs += len(arrs)
         if chunk:
             yield chunk
 
@@ -714,56 +728,34 @@ class TCPComm(CommEngine):
 
     def _rx_deliver(self, st: _RecvState) -> int:
         """Frame complete: rebuild the batch with arrays aliasing the
-        arena slots, arm per-slot release-on-death, dispatch."""
-        views = [memoryview(c.payload)[:ln]
-                 for c, ln in zip(st.bufs, st.lens)]
+        arena slots, dispatch.  Slot lifetime rides the buffer-reference
+        chain, not structure inspection: pickle.loads is handed a
+        memoryview of a *holder* ndarray view per slot, and anything
+        reconstructed over that buffer keeps the memoryview — hence the
+        holder — alive (PEP 3118 exporter chain; works for arrays nested
+        in ANY container, custom objects included).  A weakref finalizer
+        on the holder returns the slot exactly when the last consumer
+        dies; if nothing aliased the buffer the holder dies as soon as
+        this frame's locals do."""
+        holders = []
+        views = []
+        for c, ln in zip(st.bufs, st.lens):
+            holder = c.payload[:ln]  # ndarray view: weakref-able anchor
+            weakref.finalize(holder, c.arena.release, c)
+            holders.append(holder)
+            views.append(memoryview(holder))
         try:
             src, batch = pickle.loads(st.ctl, buffers=views)
         except Exception as e:
             debug.error("rank %d: undecodable frame: %s", self.rank, e)
-            for c in st.bufs:
-                c.arena.release(c)
-            return 0
-        self._rx_retire(st.bufs, st.lens, batch)
+            return 0  # finalizers recycle the slots as holders die
+        finally:
+            del views, holders  # only consumer chains keep slots alive now
         n = 0
         for tag, payload in batch:
             self._dispatch(tag, src, payload)
             n += 1
         return n
-
-    def _rx_retire(self, bufs, lens, batch) -> None:
-        """Arena slots stay checked out while any delivered array aliases
-        them (a finalizer returns the slot when the LAST aliasing array
-        dies); unreferenced slots recycle immediately."""
-        if not bufs:
-            return
-        arrs: List[np.ndarray] = []
-        _walk_arrays(batch, arrs)
-        spans = []
-        for arr in arrs:
-            try:
-                spans.append(_byte_bounds(arr))
-            except Exception:
-                spans.append((0, 0))
-        for c in bufs:
-            blo, bhi = _byte_bounds(c.payload)
-            holders = [a for a, (lo, hi) in zip(arrs, spans)
-                       if lo >= blo and hi <= bhi and a.nbytes > 0]
-            if not holders:
-                c.arena.release(c)
-                continue
-            pending = [len(holders)]
-
-            def _release(_r=None, c=c, pending=pending):
-                pending[0] -= 1
-                if pending[0] == 0:
-                    c.arena.release(c)
-
-            for a in holders:
-                try:
-                    weakref.finalize(a, _release)
-                except TypeError:  # pragma: no cover
-                    _release()
 
     def _rx_abort(self, st: _RecvState) -> None:
         """Mid-frame EOF/teardown: recycle any half-filled arena slots."""

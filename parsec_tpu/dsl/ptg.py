@@ -537,9 +537,13 @@ class PTGTaskpool(Taskpool):
             self.repos[pc.name] = DataRepo(nb_flows=len(pc.flows))
             self._build_class(pc)
         self.startup_hook = self._startup
-        # rank-local task count is known up front for a PTG (reference:
-        # computed by generated code); recomputed at attach for rank != 0
-        self.tdm.taskpool_set_nb_tasks(self, self._count_local(rank=0))
+        # the PTG manages task accounting itself: either a full pre-count
+        # at attach (dense mode needs the class boxes anyway) or the
+        # chunked startup scan's incremental adds (reference
+        # task_startup_iter/chunk, parsec.c:669-676) — never per-schedule
+        # auto counting (undiscovered tasks must hold the counter)
+        self.auto_count = False
+        self._counted = False
 
     def _make_dep_tracker(self):
         """Pick the dependency-storage backend (reference: per-class
@@ -573,8 +577,20 @@ class PTGTaskpool(Taskpool):
         return n
 
     def attached(self, context) -> None:
-        if context.rank != 0:
+        if isinstance(self.deps, DenseDepTracker):
+            # dense mode: class boxes must be registered before ANY
+            # release (a counter split across the hash fallback and the
+            # dense array would never reach its goal), and the same
+            # enumeration yields the exact local count — scan up front
             self.tdm.taskpool_set_nb_tasks(self, self._count_local(context.rank))
+            self._counted = True
+        else:
+            # hash mode: no pre-scan — the chunked startup pass counts
+            # local tasks incrementally while the first chunks already
+            # execute (add_taskpool holds a runtime action across
+            # startup, so the transiently-small count cannot quiesce)
+            self.tdm.taskpool_set_nb_tasks(self, 0)
+            self._counted = False
         if context.nranks > 1:
             n_wb = self._count_expected_writebacks(context.rank)
             if n_wb:
@@ -627,29 +643,90 @@ class PTGTaskpool(Taskpool):
             self._local_cache[pc.name] = cached
         return cached
 
+    #: local tasks discovered per accounting/scheduling step of the
+    #: chunked startup scan (reference task_startup_chunk, parsec.c:669)
+    STARTUP_CHUNK = 256
+
     def _startup(self, context, tp) -> List[Task]:
         from ..utils import debug
 
-        out = []
+        if self._counted:
+            # dense mode pre-scanned at attach: the cache holds the local
+            # space, counts are final — just pick the sources
+            out = []
+            for pc in self.ptg.classes.values():
+                undefined = 0
+                for loc in self._local_space(pc):
+                    if pc.goal_of(loc, self.constants) != 0:
+                        continue
+                    if self._is_startup(pc, loc, goal_known_zero=True):
+                        out.append(self._make_task(pc, loc))
+                    else:
+                        undefined += 1
+                self._warn_undefined(pc, undefined)
+            return out
+
+        # chunked startup (the default): ONE pass over the task space per
+        # class doing local-count + source detection, releasing each chunk
+        # to the schedulers as it is found — execution overlaps the
+        # remainder of the enumeration instead of waiting for three full
+        # prescans (reference task_startup_iter/chunk, jdf2c.c:3036).
+        # Like the reference's chunked startup, tasks of earlier chunks
+        # already RUN while later locs are scanned, so dynamic guards
+        # (bodies mutating state guards read) must not change startup
+        # MEMBERSHIP — dynamic-input tasks are held back via the
+        # `undefined` path and released by their producers.  The deps.peek
+        # guard below closes the residual window: a task some already-
+        # running producer released into is never also scheduled as a
+        # source.
+        from ..core import scheduling
+
+        myrank = context.rank if context is not None else 0
         for pc in self.ptg.classes.values():
+            cached: List[Tuple] = []
+            ready: List[Task] = []
+            pending = 0
             undefined = 0
-            for loc in self._local_space(pc):
-                if pc.goal_of(loc, self.constants) != 0:
+            for loc in pc.param_space(self.constants):
+                if pc.rank_of(loc, self.constants) != myrank:
                     continue
-                if self._is_startup(pc, loc, goal_known_zero=True):
-                    out.append(self._make_task(pc, loc))
-                else:
-                    undefined += 1
-            if undefined:
-                # goal 0 but some readable flow had no matched input dep:
-                # legitimate with dynamic guards (a producer releases the
-                # task later), a guaranteed hang if the guards are static
-                debug.verbose(
-                    2, "ptg",
-                    "%s: %d task(s) held back from startup — a readable "
-                    "flow matched no input dep; if its guards are static, "
-                    "add an explicit '<- NONE' fallback", pc.name, undefined)
-        return out
+                cached.append(loc)
+                pending += 1
+                if pc.goal_of(loc, self.constants) == 0:
+                    if not self._is_startup(pc, loc, goal_known_zero=True):
+                        undefined += 1
+                    elif self.deps.peek((pc.name, loc)) is None:
+                        ready.append(self._make_task(pc, loc))
+                    else:
+                        undefined += 1  # a producer beat the scan to it
+                if pending >= self.STARTUP_CHUNK:
+                    # count BEFORE scheduling: a chunk task retiring
+                    # instantly must never see an unaccounted self
+                    self.tdm.taskpool_addto_nb_tasks(self, pending)
+                    pending = 0
+                    if ready:
+                        scheduling.schedule_ready(context, None, ready)
+                        ready = []
+            if pending:
+                self.tdm.taskpool_addto_nb_tasks(self, pending)
+            if ready:
+                scheduling.schedule_ready(context, None, ready)
+            self._local_cache[pc.name] = cached
+            self._warn_undefined(pc, undefined)
+        return []
+
+    def _warn_undefined(self, pc: PTGTaskClass, undefined: int) -> None:
+        if undefined:
+            from ..utils import debug
+
+            # goal 0 but some readable flow had no matched input dep:
+            # legitimate with dynamic guards (a producer releases the
+            # task later), a guaranteed hang if the guards are static
+            debug.verbose(
+                2, "ptg",
+                "%s: %d task(s) held back from startup — a readable "
+                "flow matched no input dep; if its guards are static, "
+                "add an explicit '<- NONE' fallback", pc.name, undefined)
 
     def _is_startup(self, pc: PTGTaskClass, loc: Tuple,
                     goal_known_zero: bool = False) -> bool:
@@ -901,19 +978,28 @@ class PTGTaskpool(Taskpool):
         *I* own — each is one pre-counted termdet runtime action."""
         n = 0
         for pc in self.ptg.classes.values():
+            # static pre-filter: only deps that CAN resolve to a data
+            # reference matter here — classes without any skip the whole
+            # parameter space, others skip env construction per dep
+            wb_deps = [
+                (f, dep)
+                for f in pc.flows if f.mode != CTL
+                for dep in f.deps_out
+                if isinstance(dep.then, _DataRef)
+                or isinstance(getattr(dep, "otherwise", None), _DataRef)
+            ]
+            if not wb_deps:
+                continue
             for loc in pc.param_space(self.constants):
                 if pc.rank_of(loc, self.constants) == rank:
                     continue  # local task: local write-back
                 env = pc.env_of(loc, self.constants)
-                for f in pc.flows:
-                    if f.mode == CTL:
-                        continue  # never written back (see release_deps)
-                    for dep in f.deps_out:
-                        t = dep.target(env)
-                        if isinstance(t, _DataRef):
-                            dc = self.constants[t.collection_name]
-                            if dc.rank_of(*t.key(env)) == rank:
-                                n += 1
+                for _f, dep in wb_deps:
+                    t = dep.target(env)
+                    if isinstance(t, _DataRef):
+                        dc = self.constants[t.collection_name]
+                        if dc.rank_of(*t.key(env)) == rank:
+                            n += 1
         return n
 
     def incoming_activation(

@@ -215,3 +215,28 @@ def test_asymmetric_deps_detected(ctx):
     # consumer's goal counts the task-ref input, but producer never releases
     # it: the pool cannot quiesce -> bounded wait returns False
     assert tp.wait(timeout=1.0) is False
+
+
+def test_chunked_startup_overlaps_enumeration():
+    """Reference task_startup_iter/chunk (parsec.c:669-676): startup
+    releases ready chunks while the parameter-space enumeration is still
+    running, so execution is not gated on three full prescans. With a
+    started context, the first body must run well before add_taskpool
+    returns."""
+    import time
+
+    times = []
+    ptg = PTG("flood")
+    t = ptg.task_class("t", i="0 .. N-1")
+    t.body(cpu=lambda i: times.append(time.perf_counter()))
+    tp = ptg.taskpool(N=20000)
+    with Context(nb_cores=4) as ctx:
+        ctx.start()
+        t0 = time.perf_counter()
+        ctx.add_taskpool(tp)
+        t_attach = time.perf_counter()
+        assert tp.wait(timeout=120)
+    assert len(times) == 20000
+    assert min(times) < t_attach, (
+        f"no overlap: first body {min(times)-t0:.3f}s, "
+        f"attach returned {t_attach-t0:.3f}s")
