@@ -46,12 +46,36 @@ struct Task {
 // the (growable) tasks vector — streaming insertion may reallocate it
 using Ready = std::pair<int32_t, int64_t>;  // (priority, id); max-heap
 
+// Scheduler policies where scheduling natively matters (the Python
+// roster demonstrates API parity; these two differ under contention):
+//   LFQ — per-worker bounded heaps with hierarchical steal (reference
+//         mca/sched/lfq + sched_local_queues_utils.h:22-36 hbbuffers);
+//   GD  — one global priority heap (reference mca/sched/gd).
+enum Policy : int32_t { POLICY_LFQ = 0, POLICY_GD = 1 };
+
+// per-worker bounded buffer (hbbuffer role): overflow spills to the
+// shared system queue, so local push/pop is O(log cap) on an
+// uncontended mutex and the global heap only sees the excess
+constexpr size_t kLocalCap = 256;
+
+struct alignas(64) WorkerQ {
+    std::mutex mu;
+    std::priority_queue<Ready> heap;
+};
+
 struct Graph {
     std::vector<Task*> tasks;
     std::mutex graph_mu;  // guards tasks vector growth + edge insertion
-    std::priority_queue<Ready> ready;
+    std::priority_queue<Ready> ready;  // shared system queue
     std::mutex ready_mu;
     std::condition_variable ready_cv;
+    std::vector<WorkerQ> wqs;  // sized by run(); empty => global-only
+    std::atomic<int32_t> policy{POLICY_LFQ};
+    //: bumped on EVERY push (local or global): the idle-wait predicate
+    //: compares it against the epoch seen before the pop miss, closing
+    //: the lost-wakeup window between pop_ready and wait_for
+    std::atomic<uint64_t> push_epoch{0};
+    std::atomic<int64_t> n_steals{0};
     std::atomic<int64_t> n_executed{0};
     std::atomic<int64_t> n_inserted{0};
     std::atomic<bool> sealed{false};
@@ -64,45 +88,99 @@ struct Graph {
 
 using BodyFn = void (*)(int64_t task_id, int64_t user_tag, void* ctx);
 
-void push_ready(Graph* g, int32_t prio, int64_t id) {
+void push_global(Graph* g, int32_t prio, int64_t id) {
     {
         std::lock_guard<std::mutex> lk(g->ready_mu);
         g->ready.push({prio, id});
     }
+    g->push_epoch.fetch_add(1, std::memory_order_release);
     g->ready_cv.notify_one();
+}
+
+// wid < 0: caller is not a worker (streaming inserter) — always global.
+void push_ready(Graph* g, int32_t prio, int64_t id, int32_t wid) {
+    if (wid >= 0 && g->policy.load(std::memory_order_relaxed) == POLICY_LFQ &&
+        static_cast<size_t>(wid) < g->wqs.size()) {
+        WorkerQ& q = g->wqs[wid];
+        {
+            std::lock_guard<std::mutex> lk(q.mu);
+            if (q.heap.size() < kLocalCap) {
+                q.heap.push({prio, id});
+                g->push_epoch.fetch_add(1, std::memory_order_release);
+                g->ready_cv.notify_one();  // sleepers may steal it
+                return;
+            }
+        }
+    }
+    push_global(g, prio, id);
+}
+
+// Own queue first, then the shared queue, then steal round-robin from
+// the other workers (hierarchical order: nearest neighbour outward —
+// the reference walks its NUMA hierarchy; the ring is the 1-level form).
+int64_t pop_ready(Graph* g, int32_t wid) {
+    if (wid >= 0 && static_cast<size_t>(wid) < g->wqs.size()) {
+        WorkerQ& q = g->wqs[wid];
+        std::lock_guard<std::mutex> lk(q.mu);
+        if (!q.heap.empty()) {
+            int64_t id = q.heap.top().second;
+            q.heap.pop();
+            return id;
+        }
+    }
+    {
+        std::lock_guard<std::mutex> lk(g->ready_mu);
+        if (!g->ready.empty()) {
+            int64_t id = g->ready.top().second;
+            g->ready.pop();
+            return id;
+        }
+    }
+    size_t nw = g->wqs.size();
+    if (wid >= 0 && nw > 1) {
+        for (size_t d = 1; d < nw; ++d) {
+            WorkerQ& v = g->wqs[(static_cast<size_t>(wid) + d) % nw];
+            std::unique_lock<std::mutex> lk(v.mu, std::try_to_lock);
+            if (!lk.owns_lock() || v.heap.empty()) continue;
+            int64_t id = v.heap.top().second;
+            v.heap.pop();
+            g->n_steals.fetch_add(1, std::memory_order_relaxed);
+            return id;
+        }
+    }
+    return -1;
 }
 
 // Complete a task: release successors whose last predecessor this was.
 // Returns the highest-priority newly-ready successor for the calling
 // worker to run next (the reference keeps it in es->next_task instead of
 // round-tripping through the scheduler), or -1.
-int64_t complete(Graph* g, int64_t id) {
-    Task* t;
+int64_t complete(Graph* g, int64_t id, int32_t wid) {
     std::vector<int64_t> succs;
+    std::vector<Task*> stasks;
     {
         std::lock_guard<std::mutex> lk(g->graph_mu);
-        t = g->tasks[id];
+        Task* t = g->tasks[id];
         t->done.store(true, std::memory_order_release);
         succs = t->succs;  // snapshot: edges to a done task are rejected
+        stasks.reserve(succs.size());
+        for (int64_t s : succs) stasks.push_back(g->tasks[s]);
     }
     int64_t keep = -1;
     int32_t keep_prio = 0;
-    for (int64_t s : succs) {
-        Task* st;
-        {
-            std::lock_guard<std::mutex> lk(g->graph_mu);
-            st = g->tasks[s];
-        }
+    for (size_t i = 0; i < succs.size(); ++i) {
+        Task* st = stasks[i];
+        int64_t s = succs[i];
         if (st->missing.fetch_sub(1, std::memory_order_acq_rel) == 1) {
             if (keep < 0) {
                 keep = s;
                 keep_prio = st->priority;
             } else if (st->priority > keep_prio) {
-                push_ready(g, keep_prio, keep);
+                push_ready(g, keep_prio, keep, wid);
                 keep = s;
                 keep_prio = st->priority;
             } else {
-                push_ready(g, st->priority, s);
+                push_ready(g, st->priority, s, wid);
             }
         }
     }
@@ -116,23 +194,25 @@ bool all_done(Graph* g) {
                g->n_inserted.load(std::memory_order_acquire);
 }
 
-void worker_main(Graph* g, BodyFn body, void* ctx) {
+void worker_main(Graph* g, BodyFn body, void* ctx, int32_t wid) {
     int64_t next = -1;  // kept successor from the previous completion
     for (;;) {
         int64_t id = next;
         next = -1;
         if (id < 0) {
-            std::unique_lock<std::mutex> lk(g->ready_mu);
-            g->ready_cv.wait_for(lk, std::chrono::milliseconds(50), [&] {
-                return !g->ready.empty() || all_done(g) ||
-                       g->failed.load(std::memory_order_acquire);
-            });
-            if (!g->ready.empty()) {
-                id = g->ready.top().second;
-                g->ready.pop();
-            } else if (all_done(g) || g->failed.load(std::memory_order_acquire)) {
-                return;
-            } else {
+            uint64_t seen = g->push_epoch.load(std::memory_order_acquire);
+            id = pop_ready(g, wid);
+            if (id < 0) {
+                if (all_done(g) || g->failed.load(std::memory_order_acquire))
+                    return;
+                std::unique_lock<std::mutex> lk(g->ready_mu);
+                // predicate re-arms on ANY push since the pop miss (epoch
+                // moved), on termination, and on failure — a notify that
+                // fired before we were waiting cannot be lost
+                g->ready_cv.wait_for(lk, std::chrono::milliseconds(50), [&] {
+                    return g->push_epoch.load(std::memory_order_acquire) != seen ||
+                           all_done(g) || g->failed.load(std::memory_order_acquire);
+                });
                 continue;
             }
         }
@@ -142,10 +222,12 @@ void worker_main(Graph* g, BodyFn body, void* ctx) {
             t = g->tasks[id];
         }
         body(id, t->user_tag, ctx);
-        next = complete(g, id);
+        next = complete(g, id, wid);
         if (all_done(g)) g->ready_cv.notify_all();
     }
 }
+
+void noop_body(int64_t, int64_t, void*) {}
 
 }  // namespace
 
@@ -197,7 +279,18 @@ void pz_graph_task_commit(void* gp, int64_t id) {
         t = g->tasks[id];
     }
     if (t->missing.fetch_sub(1, std::memory_order_acq_rel) == 1)
-        push_ready(g, t->priority, id);
+        push_ready(g, t->priority, id, -1);  // inserter thread: global
+}
+
+// Select the scheduling policy (0 = lfq per-worker + steal, 1 = gd
+// global heap). Takes effect for pushes from the next run.
+void pz_graph_set_policy(void* gp, int32_t policy) {
+    static_cast<Graph*>(gp)->policy.store(
+        policy == 1 ? POLICY_GD : POLICY_LFQ, std::memory_order_relaxed);
+}
+
+int64_t pz_graph_steals(void* gp) {
+    return static_cast<Graph*>(gp)->n_steals.load(std::memory_order_relaxed);
 }
 
 // No more tasks will be inserted; run() returns once everything executed.
@@ -213,14 +306,24 @@ void pz_graph_seal(void* gp) {
 int64_t pz_graph_run(void* gp, BodyFn body, void* ctx, int32_t nthreads) {
     Graph* g = static_cast<Graph*>(gp);
     if (nthreads < 1) nthreads = 1;
+    if (g->policy.load(std::memory_order_relaxed) == POLICY_LFQ)
+        g->wqs = std::vector<WorkerQ>(nthreads);
+    else
+        g->wqs.clear();
     std::vector<std::thread> ts;
     ts.reserve(nthreads - 1);
     for (int32_t i = 1; i < nthreads; ++i)
-        ts.emplace_back(worker_main, g, body, ctx);
-    worker_main(g, body, ctx);
+        ts.emplace_back(worker_main, g, body, ctx, i);
+    worker_main(g, body, ctx, 0);
     for (auto& th : ts) th.join();
     if (!all_done(g)) return -1;
     return g->n_executed.load(std::memory_order_acquire);
+}
+
+// Dispatch-bound benchmark entry: run with a native no-op body (no GIL
+// round-trip), isolating pure scheduling throughput.
+int64_t pz_graph_run_noop(void* gp, int32_t nthreads) {
+    return pz_graph_run(gp, noop_body, nullptr, nthreads);
 }
 
 int64_t pz_graph_executed(void* gp) {

@@ -78,12 +78,6 @@ _WIRE_VERSION = 2
 _RANK = struct.Struct("!i")
 _MISSING = object()
 
-try:  # numpy >= 2.0
-    from numpy.lib.array_utils import byte_bounds as _byte_bounds
-except ImportError:  # pragma: no cover - numpy 1.x
-    _byte_bounds = np.byte_bounds
-
-
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:

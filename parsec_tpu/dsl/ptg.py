@@ -533,6 +533,11 @@ class PTGTaskpool(Taskpool):
         self._class_box: Dict[str, Tuple] = {}
         self._new_tiles: Dict[Tuple, Data] = {}
         self._new_lock = threading.Lock()
+        #: exactly-once guard for GOAL-0 tasks: the chunked startup scan
+        #: and a producer release (possible with dynamic guards) may both
+        #: decide to schedule one — whoever claims first wins
+        self._source_claims: set = set()
+        self._claims_lock = threading.Lock()
         for pc in ptg.classes.values():
             self.repos[pc.name] = DataRepo(nb_flows=len(pc.flows))
             self._build_class(pc)
@@ -695,7 +700,7 @@ class PTGTaskpool(Taskpool):
                 if pc.goal_of(loc, self.constants) == 0:
                     if not self._is_startup(pc, loc, goal_known_zero=True):
                         undefined += 1
-                    elif self.deps.peek((pc.name, loc)) is None:
+                    elif self._claim_source(pc.name, loc):
                         ready.append(self._make_task(pc, loc))
                     else:
                         undefined += 1  # a producer beat the scan to it
@@ -714,6 +719,19 @@ class PTGTaskpool(Taskpool):
             self._local_cache[pc.name] = cached
             self._warn_undefined(pc, undefined)
         return []
+
+    def _claim_source(self, name: str, locs: Tuple) -> bool:
+        """Atomically claim the right to schedule a goal-0 task.  Closes
+        the race between the chunked startup scan and a concurrent
+        producer release firing into the same task (dynamic guards):
+        release_counter's delete-on-fire leaves nothing for a peek to
+        see, so exactly-once needs its own claim."""
+        key = (name, locs)
+        with self._claims_lock:
+            if key in self._source_claims:
+                return False
+            self._source_claims.add(key)
+            return True
 
     def _warn_undefined(self, pc: PTGTaskClass, undefined: int) -> None:
         if undefined:
@@ -915,9 +933,12 @@ class PTGTaskpool(Taskpool):
                     self, pc.name, task.locals, rank_masks, flow_payloads)
             ready: List[Task] = []
             for succ_pc, locs in succ_list:
-                became, _ = self.deps.release_counter(
-                    (succ_pc.name, locs), succ_pc.goal_of(locs, self.constants))
-                if became:
+                goal = succ_pc.goal_of(locs, self.constants)
+                became, _ = self.deps.release_counter((succ_pc.name, locs), goal)
+                if became and (goal != 0
+                               or self._claim_source(succ_pc.name, locs)):
+                    # goal-0 successors (dynamic guards) race the chunked
+                    # startup scan: the claim keeps execution exactly-once
                     ready.append(self._make_task(succ_pc, locs))
             return ready
 
@@ -1056,10 +1077,11 @@ class PTGTaskpool(Taskpool):
                                     payload=payload)
                             deposited = True
                         nb_consumers += 1
+                    goal = succ_pc.goal_of(locs, self.constants)
                     became, _ = self.deps.release_counter(
-                        (t.class_name, locs),
-                        succ_pc.goal_of(locs, self.constants))
-                    if became:
+                        (t.class_name, locs), goal)
+                    if became and (goal != 0
+                                   or self._claim_source(t.class_name, locs)):
                         ready.append(self._make_task(succ_pc, locs))
         if entry is not None:
             repo.set_usage_limit(src_locals, nb_consumers)

@@ -115,6 +115,11 @@ def _load():
                                      ctypes.c_int32]
         lib.pz_graph_executed.restype = ctypes.c_int64
         lib.pz_graph_executed.argtypes = [ctypes.c_void_p]
+        lib.pz_graph_set_policy.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.pz_graph_steals.restype = ctypes.c_int64
+        lib.pz_graph_steals.argtypes = [ctypes.c_void_p]
+        lib.pz_graph_run_noop.restype = ctypes.c_int64
+        lib.pz_graph_run_noop.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.pz_graph_order.restype = ctypes.c_int64
         lib.pz_graph_order.argtypes = [ctypes.c_void_p,
                                        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
@@ -233,6 +238,26 @@ class NativeGraph:
 
     def seal(self) -> None:
         self._lib.pz_graph_seal(self._g)
+
+    POLICIES = {"lfq": 0, "gd": 1}
+
+    def set_policy(self, policy: str) -> None:
+        """Scheduling policy: ``lfq`` (per-worker bounded heaps +
+        hierarchical steal — reference sched/lfq hbbuffers, the default)
+        or ``gd`` (single global priority heap — reference sched/gd)."""
+        self._lib.pz_graph_set_policy(self._g, self.POLICIES[policy])
+
+    @property
+    def steals(self) -> int:
+        return self._lib.pz_graph_steals(self._g)
+
+    def run_noop(self, nthreads: int = 2) -> int:
+        """Dispatch-bound run with a NATIVE no-op body (no GIL): isolates
+        pure scheduling throughput for benchmarks."""
+        n = self._lib.pz_graph_run_noop(self._g, nthreads)
+        if n < 0:
+            raise RuntimeError("graph did not quiesce")
+        return n
 
     def run(self, body: Callable[[int, int], None], nthreads: int = 2) -> int:
         """Execute until quiescence; returns executed count. Exceptions

