@@ -35,6 +35,21 @@ def _key_words(key) -> int:
     return len(key) if isinstance(key, (tuple, list)) else 1
 
 
+def _fail_pool(tp, why: str) -> bool:
+    """Force-fail a taskpool over an unrecoverable comm loss, with the
+    same Context pairing as ``Context.abort`` (context.py:176-181): the
+    pool must leave the context's active set, or ``Context.wait()`` would
+    still hang on ``_active_taskpools`` even though ``tp.wait()`` returns.
+    Returns True only on the terminating transition."""
+    if not tp._force_fail():
+        return False  # already terminated (normally or by an earlier failure)
+    debug.error("taskpool %s failed: %s", tp.name, why)
+    ctx = getattr(tp, "context", None)
+    if ctx is not None:
+        ctx._taskpool_terminated(tp)
+    return True
+
+
 def _wire_len(msg: dict) -> int:
     """Logical activation-header length in bytes (reference
     ``remote_dep_wire_activate_t``: taskpool_id, task_class_id, locals,
@@ -161,6 +176,7 @@ class RemoteDepManager:
         src_locals: Tuple,
         targets: List[Tuple[int, int]],
         flow_payloads: Dict[int, np.ndarray],
+        lost_mask: int = 0,
     ) -> None:
         """Send one aggregated activation to each topology child, with its
         subtree attached as the forward set (used by the producer AND by
@@ -209,6 +225,10 @@ class RemoteDepManager:
                 "fwd": subtree,
                 "flows": flows,
             }
+            if lost_mask:
+                # flows lost upstream (failed GET): tell the subtree so
+                # every downstream rank fails fast instead of timing out
+                msg["lost"] = lost_mask
             self.stats["activations_sent"] += 1
             if pins.active(pins.COMM_ACTIVATE):
                 pins.fire(pins.COMM_ACTIVATE, None,
@@ -241,8 +261,30 @@ class RemoteDepManager:
         if tp is not None:
             self._deliver(tp, src_rank, msg)
 
+    def _fail_pool_everywhere(self, tp, why: str) -> None:
+        """Fail the pool on EVERY rank, not just locally: ranks outside
+        the broadcast subtree (the producer, write-back-counting tile
+        owners) would otherwise still discover the loss by exhausting
+        their full wait() timeout.  Failures are rare; R-1 tiny abort
+        messages are nothing.  Broadcast only on the terminating
+        transition — a pool losing many in-flight payloads must not
+        re-notify every peer per loss."""
+        if not _fail_pool(tp, why):
+            return
+        msg = {"pool": tp.name, "kind": "abort", "why": why}
+        for r in range(getattr(self.ce, "nranks", 1)):
+            if r != getattr(self.ce, "rank", 0):
+                try:
+                    self.ce.send_am(TAG_ACTIVATE, r, msg)
+                except Exception as e:  # a dead peer must not mask the fail
+                    debug.error("abort notify to rank %d failed: %s", r, e)
+
     def _deliver(self, tp, src_rank: int, msg: dict) -> None:
         kind = msg["kind"]
+        if kind == "abort":
+            _fail_pool(tp, "aborted by rank %d: %s"
+                       % (src_rank, msg.get("why", "")))
+            return
         if kind == "writeback":
             self.stats["writebacks_recv"] += 1
             tp.incoming_writeback(msg["collection"], tuple(msg["key"]),
@@ -262,19 +304,21 @@ class RemoteDepManager:
                     pins.fire(pins.COMM_DATA_PLD, None,
                               {"bytes": d["data"].nbytes, "kind": "inline"})
         if not gets:
-            self._complete_incoming(tp, msg, resolved)
+            self._complete_incoming(tp, msg, resolved, msg.get("lost", 0))
             return
         remaining = [len(gets)]  # comm-thread-serial on TCP; lock-free ok
-        failed = [0]
+        failed = [msg.get("lost", 0)]
 
         def arrived(fi, buf):
             if buf is None:
-                # GET failed (handle gone at the source): degrade, don't
-                # hang — only THIS flow's successors stall, everything
-                # else in the activation and the forward subtree proceeds
+                # GET failed (handle gone at the source): the payload is
+                # permanently lost.  The surviving flows still propagate
+                # down the tree, then _complete_incoming fail-fasts the
+                # pool on every rank (abort broadcast) — wait() returns
+                # False promptly instead of timing out.
                 debug.error(
-                    "activation %s%r flow %d: payload GET failed; its "
-                    "successors will not be released",
+                    "activation %s%r flow %d: payload GET failed; "
+                    "failing the pool",
                     msg["src_class"], tuple(msg["src_locals"]), fi)
                 failed[0] |= 1 << fi
             else:
@@ -310,13 +354,28 @@ class RemoteDepManager:
         if fwd:
             self.stats["forwarded"] += 1
             self._send_tree(msg["pool"], msg["src_class"],
-                            tuple(msg["src_locals"]), fwd, resolved)
+                            tuple(msg["src_locals"]), fwd, resolved,
+                            lost_mask=failed_mask)
         tp.incoming_activation(
             src_class=msg["src_class"],
             src_locals=tuple(msg["src_locals"]),
             mask=msg["mask"] & ~failed_mask,
             flow_data=resolved,
         )
+        if failed_mask:
+            # a payload is permanently lost: the masked-out successors can
+            # never run, so this pool can never quiesce — fail it now
+            # (after propagating the surviving flows AND the lost mask, so
+            # the whole subtree fails fast too) so wait() returns promptly
+            # instead of timing out.  Only the rank that DISCOVERED the
+            # loss (no "lost" bit from upstream) broadcasts the abort;
+            # subtree ranks fail locally off the mask they were handed.
+            why = "lost payload(s) of %s%r (mask %#x)" % (
+                msg["src_class"], tuple(msg["src_locals"]), failed_mask)
+            if failed_mask & ~msg.get("lost", 0):
+                self._fail_pool_everywhere(tp, why)
+            else:
+                _fail_pool(tp, why)
 
     # -- DTD tile-version channel (shadow-task protocol) -----------------
     def send_dtd(self, tp, wire_key, epoch: int, payload: np.ndarray, dst_rank: int) -> None:
@@ -359,8 +418,11 @@ class RemoteDepManager:
 
         def arrived(buf):
             if buf is None:  # failed GET (see _on_get_ans error path)
-                debug.error("dtd tile %r epoch %s: payload GET failed",
-                            key, msg["epoch"])
+                # the consumer task can never run — fail the pool on every
+                # rank so wait() returns promptly instead of timing out
+                self._fail_pool_everywhere(
+                    tp, "dtd tile %r epoch %s: payload GET failed"
+                    % (key, msg["epoch"]))
                 return
             if pins.active(pins.COMM_DATA_PLD):
                 pins.fire(pins.COMM_DATA_PLD, None,
@@ -368,6 +430,11 @@ class RemoteDepManager:
             tp.dtd_incoming(key, msg["epoch"], buf)
 
         if msg["kind"] == "get":
-            self.ce.get(src_rank, msg["handle"], arrived)
+            try:
+                self.ce.get(src_rank, msg["handle"], arrived)
+            except Exception as e:  # inproc raises synchronously
+                debug.error("dtd GET %r from %d raised: %s",
+                            msg["handle"], src_rank, e)
+                arrived(None)
         else:
             arrived(msg["data"])

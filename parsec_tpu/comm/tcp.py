@@ -77,6 +77,10 @@ _MAGIC = 0x9A7C
 _WIRE_VERSION = 2
 _RANK = struct.Struct("!i")
 _MISSING = object()
+#: protocol constant: out-of-band buffers one frame may carry; the
+#: receiver drops the connection as corrupt above this (must agree with
+#: every peer's sender-side chunking/diagnostics)
+_MAX_OOB_BUFS = 65536
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
@@ -208,10 +212,20 @@ class TCPComm(CommEngine):
         self._closing = threading.Event()
         #: ranks whose FIN frame arrived (touched only on the comm thread)
         self._peer_fin: set = set()
-        self.close_timeout = 10.0
+        # Endpoints are expected to close roughly together (after a
+        # barrier / taskpool quiesce); a rank closing while peers keep
+        # computing waits out close_timeout for their FINs, then closes
+        # anyway (mid-stream truncation risk is back on that peer).
+        self.close_timeout = mca_param.register(
+            "runtime", "comm_close_timeout", 10.0,
+            help="seconds close() waits for peer FIN frames before "
+                 "closing sockets anyway")
         #: wedged-peer bound for one frame write; close() must wait out at
         #: least one full send before giving up on the comm thread
-        self.send_timeout = 30.0
+        self.send_timeout = mca_param.register(
+            "runtime", "comm_send_timeout", 30.0,
+            help="seconds a single frame write may block before the "
+                 "peer is declared wedged and the connection dropped")
         self._barrier_epoch = 0
         self._barrier_state: Dict[int, Any] = {}
         self._barrier_cv = threading.Condition()
@@ -525,6 +539,12 @@ class TCPComm(CommEngine):
                     "comm_max_frame (%d) — the receiver will drop the "
                     "connection; raise the runtime_comm_max_frame param",
                     self.rank, w, self.max_frame)
+            if len(arrs) > _MAX_OOB_BUFS:
+                debug.error(
+                    "rank %d: single AM payload carries %d arrays, above "
+                    "the receiver's %d out-of-band buffer cap — the "
+                    "receiver will drop the connection; split the payload",
+                    self.rank, len(arrs), _MAX_OOB_BUFS)
             chunk.append(item)
             weight += w
             nbufs += len(arrs)
@@ -656,7 +676,7 @@ class TCPComm(CommEngine):
                             "dropping connection", self.rank, peer, magic, ver)
                 self._drop_peer(peer, st)
                 return 0
-            if ctl_len > self.max_frame or nbufs > 65536:
+            if ctl_len > self.max_frame or nbufs > _MAX_OOB_BUFS:
                 debug.error("rank %d: oversized frame from %d (ctl=%d nbufs=%d)"
                             " — dropping connection", self.rank, peer, ctl_len, nbufs)
                 self._drop_peer(peer, st)

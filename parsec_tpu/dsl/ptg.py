@@ -664,10 +664,14 @@ class PTGTaskpool(Taskpool):
                 for loc in self._local_space(pc):
                     if pc.goal_of(loc, self.constants) != 0:
                         continue
-                    if self._is_startup(pc, loc, goal_known_zero=True):
+                    if not self._is_startup(pc, loc, goal_known_zero=True):
+                        undefined += 1
+                    elif self._claim_source(pc.name, loc):
+                        # same exactly-once claim as the chunked branch: with
+                        # dynamic guards a producer release can race this scan
                         out.append(self._make_task(pc, loc))
                     else:
-                        undefined += 1
+                        undefined += 1  # a producer beat the scan to it
                 self._warn_undefined(pc, undefined)
             return out
 

@@ -50,21 +50,42 @@ def test_policies_execute_everything(policy):
 
 
 def test_lfq_steals_under_imbalance():
-    """A single producer fanning out floods its local queue; the other
-    workers must actually STEAL (hierarchical ring) — pins that the
-    per-worker path is exercised, not silently falling back to the
-    global heap.  Width ~300: the producer's bounded queue (cap 256)
-    holds most of the level, the global overflow is tiny, so idle
-    workers MUST steal to keep busy."""
-    total_steals = 0
-    for _ in range(8):  # timing-dependent: any hit across attempts pins it
-        g, n = _wide_graph(16, 300)
-        g.set_policy("lfq")
-        assert g.run_noop(8) == n
-        total_steals += g.steals
-        if total_steals:
-            break
-    assert total_steals > 0
+    """Deterministic imbalance: one source fans out 300 kids (flooding
+    the completing worker's bounded local queue, cap 256; ~44 spill
+    global) plus a high-priority chain head the worker KEEPS (keep-next
+    fast path).  Each chain body extends the chain via streaming
+    insertion until a steal is observed, so the flooding worker never
+    pops its own local queue while the kids sit in it — the other
+    workers drain the small global spill and then MUST steal.  The
+    chain stops extending once ``g.steals > 0`` (or at a safety cap so
+    a broken steal path fails the assert instead of hanging)."""
+    g = native.NativeGraph()
+    CHAIN, KID, SRC = 1, 0, 2
+    src = g.add_task(5, SRC)  # NOT chain-tagged: exactly one chain exists,
+    # so extension bodies run strictly serially (no counter race)
+    head = g.add_task(10, CHAIN)  # higher prio than kids: the keep
+    g.add_dep(src, head)
+    kids = [g.add_task(0, KID) for _ in range(300)]
+    for k in kids:
+        g.add_dep(src, k)
+    g.set_policy("lfq")
+    extended = [0]
+
+    def body(tid, tag):
+        if tag == CHAIN and g.steals == 0 and extended[0] < 100_000:
+            extended[0] += 1
+            t = g.add_task(10, CHAIN)
+            g.add_dep(tid, t)  # tid is mid-body: not done, edge records
+            g.commit(t)
+
+    g.commit(src)
+    g.commit(head)
+    for k in kids:
+        g.commit(k)
+    g.seal()
+    executed = g.run(body, nthreads=8)
+    assert executed == 302 + extended[0]  # src + head + 300 kids + chain
+    assert g.steals > 0
 
 
 def test_gd_never_steals():

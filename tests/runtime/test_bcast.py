@@ -66,6 +66,77 @@ def test_activation_aggregation_one_message_per_rank():
     assert ctxs[1].comm.remote_dep.stats["activations_recv"] == 1
 
 
+def test_failed_get_fails_pool_fast():
+    """A permanently lost payload (GET against a never-registered handle)
+    must FAIL the taskpool promptly on EVERY rank — wait() returns False
+    in seconds, not after the full timeout (ADVICE r2: the runtime knows
+    the payload is gone; callers must not discover it via timeout).
+    Rank 2 owns the home tile of the dead consumer's write-back (a
+    pre-counted termdet runtime action) — without the abort broadcast it
+    would block its full timeout even though rank 1 failed instantly."""
+    import threading
+    import time
+
+    from parsec_tpu import Context
+    from parsec_tpu.comm.inproc import InprocFabric
+
+    nranks = 3
+    mca_param.set_param("runtime", "comm_short_limit", 8)
+    try:
+        fabric = InprocFabric(nranks)
+        ces = fabric.endpoints()
+        # sabotage the producer: payloads are advertised but never
+        # registered, so every consumer GET permanently fails
+        ces[0].mem_register = lambda *a, **k: None
+        ctxs = [Context(nb_cores=2, rank=r, nranks=nranks, comm=ces[r])
+                for r in range(nranks)]
+        waits = {}
+
+        def build(rank, ctx):
+            dc = LocalCollection("D", shape=(64,), nodes=nranks, myrank=rank,
+                                 init=lambda k: np.full(64, 1.0))
+            dc.rank_of = lambda *key: dc.data_key(*key) % nranks
+            ptg = PTG("lost")
+            src = ptg.task_class("src")
+            src.affinity("D(0)")
+            src.flow("X", INOUT, "<- D(0)", "-> X sink(1)")
+            src.body(cpu=lambda X: X.__iadd__(1.0))
+            sink = ptg.task_class("sink", r="1 .. 1")
+            sink.affinity("D(r)")
+            # write-back home tile D(2) lives on rank 2: that rank
+            # pre-counts the write-back and can only quiesce if the
+            # sink runs — or the abort reaches it
+            sink.flow("X", INOUT, "<- X src()", "-> D(2)")
+            sink.body(cpu=lambda X, r: None)
+            return ptg.taskpool(D=dc)
+
+        def worker(r):
+            tp = build(r, ctxs[r])
+            ctxs[r].add_taskpool(tp)
+            t0 = time.monotonic()
+            ok = tp.wait(timeout=30)
+            waits[r] = (ok, time.monotonic() - t0, tp)
+
+        threads = [threading.Thread(target=worker, args=(r,))
+                   for r in range(nranks)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        # the consumer rank AND the write-back owner failed FAST (not by
+        # exhausting the timeout); Context.wait agrees (pools left the
+        # active set)
+        for r in (1, 2):
+            ok_r, dt_r, tp_r = waits[r]
+            assert not ok_r and tp_r.failed, (r, waits)
+            assert dt_r < 10.0, f"rank {r} fail-fast took {dt_r:.1f}s"
+            assert ctxs[r].wait(timeout=5)
+        for c in ctxs:
+            c.fini()
+    finally:
+        mca_param.params.unset("runtime", "comm_short_limit")
+
+
 @pytest.mark.parametrize("topo,root_sends,root_gets", [
     ("star", 7, 7),
     ("chain", 1, 1),
