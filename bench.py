@@ -220,11 +220,19 @@ def main() -> None:
     t_task = dynamic_once()
     ctx.fini()
 
-    # ---- north-star proxy: whole-program panel Cholesky ----------------
-    # ALL panel steps traced into ONE jitted program (ops/panel_chol.py
-    # WholeCholesky): compile is O(panels), so N>=16384 nb=512 — the
-    # closest reachable proxy for the BASELINE north star — runs at full
-    # TFLOPS where the per-task whole-DAG unroll cannot compile at all.
+    # ---- north star: panel Cholesky, whole-program AND runtime ---------
+    # Two paths at the north-star size (N>=16384 nb=512), measured
+    # INTERLEAVED so the tunnel conditions are shared:
+    #  * whole_chol_*: ALL panel steps traced into ONE jitted program
+    #    (ops/panel_chol.WholeCholesky) — the runtime-bypassing ceiling;
+    #  * runtime_chol_*: the SAME panel math as NT tasks through
+    #    taskpool + scheduler + TPU device module
+    #    (ops/segmented_chol.SegmentedCholesky) — the framework executing
+    #    the DAG, eager async dispatch, per-k statically-specialised
+    #    programs, donated in-place matrix.
+    # Both run XLA's default TPU matmul precision (bf16 compute, f32
+    # accumulate/storage) and carry the _bf16 label + the 1e-2 bf16-class
+    # gate; the f32 graph variants above keep their 1e-3 gate.
     panel_fields = {}
     if on_accel and os.environ.get("BENCH_PANEL", "1") != "0":
         try:
@@ -265,16 +273,23 @@ def main() -> None:
 
 
 def panel_stage(n: int, nb: int, measure) -> dict:
-    """Whole-program panel dpotrf at the north-star proxy size; returns
-    extra JSON fields. Numerics-gated on-device against the monolithic
-    kernel at the same size (scalar fetch only — no N^2 transfers)."""
+    """North-star panel dpotrf: the whole-program trace AND the runtime
+    (taskpool+scheduler+device) path, interleaved under the same tunnel
+    conditions; returns extra JSON fields.  Every measured rep factorizes
+    a REAL SPD matrix (a fresh device copy of the pristine input — never
+    the previous output); the copy's own slope-measured cost is
+    subtracted.  Numerics-gated on-device by sampled reconstruction
+    (scalar fetch only — no N^2 transfers); both paths run XLA's default
+    TPU matmul precision, hence the explicit _bf16 field label and the
+    1e-2 bf16-class gate (the f32 graph variants above keep 1e-3)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
+    from parsec_tpu import Context
     from parsec_tpu.ops.panel_chol import WholeCholesky
+    from parsec_tpu.ops.segmented_chol import SegmentedCholesky
 
-    wc = WholeCholesky(n, nb, strip=4096)
     blk = 2048
 
     @jax.jit
@@ -304,30 +319,50 @@ def panel_stage(n: int, nb: int, measure) -> dict:
         rec = Lt[idx] @ Lt.T[:, idx]
         return jnp.abs(rec - S[jnp.ix_(idx, idx)]).max() / jnp.abs(S).max()
 
-    A = make_spd()
+    copy = jax.jit(lambda x: x + 0.0)
+    pristine = make_spd()
+    jax.device_get(pristine.ravel()[0])
+    flops = n**3 / 3.0
+
+    wc = WholeCholesky(n, nb, strip=4096)
     t0 = time.perf_counter()
-    A = wc.run(A)
-    err = float(gate(A))  # also the first full sync (compile + run)
-    t_first = time.perf_counter() - t0
-    # bf16-class bar: XLA's default TPU matmul precision computes in
-    # bf16 with f32 accumulation/storage (same class as the graph
-    # path's gated bf16 mode)
-    if not np.isfinite(err) or err > 1e-2:
-        raise RuntimeError(f"panel numerics off ({err})")
-    box = [A]
+    err_w = float(gate(wc.run(copy(pristine))))  # compile + run + sync
+    t_first_w = time.perf_counter() - t0
+    if not np.isfinite(err_w) or err_w > 1e-2:
+        raise RuntimeError(f"whole-chol numerics off ({err_w})")
 
-    def once():
-        # re-factorizing the previous output keeps shapes/flops identical
-        # (values are scratch after run 1; numerics were gated above)
-        box[0] = wc.run(box[0])
-        return box[0]
+    ctx = Context(nb_cores=int(os.environ.get("BENCH_CORES", "2")))
+    try:
+        sc = SegmentedCholesky(ctx, n, nb, strip=4096)
+        t0 = time.perf_counter()
+        err_r = float(gate(sc.run(copy(pristine))))
+        t_first_r = time.perf_counter() - t0
+        if not np.isfinite(err_r) or err_r > 1e-2:
+            raise RuntimeError(f"runtime-chol numerics off ({err_r})")
 
-    dt = measure(once, 2)
-    g = n**3 / 3.0 / dt / 1e9
+        t_copy = measure(lambda: copy(pristine), 2)
+        # interleaved, best of two rounds per path: the tunnel's enqueue-
+        # latency jitter starves any multi-program path of the device
+        # (the whole-program trace is immune only because it is ONE
+        # enqueue RPC), so a single bad round reflects the tunnel, not
+        # the framework; best-of-2 under identical interleaving is the
+        # fairest single number this environment can produce
+        t_whole = measure(lambda: wc.run(copy(pristine)), 2) - t_copy
+        t_rt = measure(lambda: sc.run(copy(pristine)), 2) - t_copy
+        t_whole2 = measure(lambda: wc.run(copy(pristine)), 2) - t_copy
+        t_rt2 = measure(lambda: sc.run(copy(pristine)), 2) - t_copy
+    finally:
+        ctx.fini()
+    g_whole = flops / min(t_whole, t_whole2) / 1e9
+    g_rt = flops / min(t_rt, t_rt2) / 1e9
     return {
-        f"whole_chol_N{n}_nb{nb}_gflops": round(g, 2),
-        "whole_chol_compile_s": round(t_first, 1),
-        "whole_chol_err": float(f"{err:.2e}"),
+        f"whole_chol_N{n}_nb{nb}_bf16_gflops": round(g_whole, 2),
+        f"runtime_chol_N{n}_nb{nb}_bf16_gflops": round(g_rt, 2),
+        "runtime_vs_whole": round(g_rt / g_whole, 3),
+        "whole_chol_compile_s": round(t_first_w, 1),
+        "runtime_chol_compile_s": round(t_first_r, 1),
+        "whole_chol_err": float(f"{err_w:.2e}"),
+        "runtime_chol_err": float(f"{err_r:.2e}"),
     }
 
 

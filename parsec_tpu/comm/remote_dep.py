@@ -71,6 +71,9 @@ class RemoteDepManager:
         #: parked activations for unknown taskpools (reference noobj fifo)
         self._noobj: Dict[str, List[Tuple[int, dict]]] = collections.defaultdict(list)
         self._noobj_dtd: Dict[str, List[Tuple[int, dict]]] = collections.defaultdict(list)
+        #: names of pools that finished here (cleared on name reuse) —
+        #: discriminates stale aborts from startup-skew aborts
+        self._completed: set = set()
         self._lock = threading.Lock()
         self.short_limit = mca_param.register(
             "runtime", "comm_short_limit", 1 << 16,
@@ -94,6 +97,9 @@ class RemoteDepManager:
     def new_taskpool(self, tp) -> None:
         with self._lock:
             self._taskpools[tp.name] = tp
+            # the name now denotes THIS logical run: a later abort for it
+            # is live again (see _on_activate's completed-name check)
+            self._completed.discard(tp.name)
             parked = self._noobj.pop(tp.name, [])
             parked_dtd = self._noobj_dtd.pop(tp.name, [])
         for src, msg in parked:
@@ -106,6 +112,7 @@ class RemoteDepManager:
             self._taskpools.pop(tp.name, None)
             self._noobj.pop(tp.name, None)
             self._noobj_dtd.pop(tp.name, None)
+            self._completed.add(tp.name)
 
     def _lookup_or_park(self, src_rank: int, msg: dict, parked, stat: str):
         """Resolve the target taskpool or park the message until it
@@ -257,6 +264,23 @@ class RemoteDepManager:
 
     # -- receiver side ---------------------------------------------------
     def _on_activate(self, src_rank: int, msg: dict) -> None:
+        if msg.get("kind") == "abort":
+            # three cases, discriminated so an abort neither hangs a
+            # startup-skewed rank NOR poisons a later same-named run:
+            #  * pool live here        -> deliver (fail it now);
+            #  * pool ALREADY FINISHED -> drop: this rank's wait()
+            #    returned long ago; parking would replay the abort into
+            #    the next pool that reuses the name, killing a healthy
+            #    run;
+            #  * pool not yet seen     -> park: this rank is still
+            #    attaching (startup skew) and must fail at registration,
+            #    not discover the loss by exhausting its wait() timeout.
+            with self._lock:
+                if msg["pool"] in self._completed:
+                    debug.verbose(3, "comm", "abort for finished pool %s "
+                                  "from rank %d: dropped", msg["pool"],
+                                  src_rank)
+                    return
         tp = self._lookup_or_park(src_rank, msg, self._noobj, "parked")
         if tp is not None:
             self._deliver(tp, src_rank, msg)

@@ -104,6 +104,20 @@ class Context:
         self.devices = devmod.attach_devices(self, devices)
 
         self._cv = threading.Condition()
+        #: idle-wait cap (reference exponential nanosleep cap,
+        #: scheduling.c:768-771).  Every work source notifies the cv
+        #: (schedule_ready, taskpool termination, comm arrivals), so the
+        #: cap only bounds staleness of the POLLED fallbacks
+        #: (progress_comm).  It must be generous: each idle wake runs a
+        #: scheduler select under the GIL, and at a 1 ms cap a handful of
+        #: idle threads measurably slows an active worker's async device
+        #: dispatch (5x on jit-call enqueue) — the exact hot path the
+        #: device manager lives on.
+        self._idle_backoff_max = mca_param.register(
+            "runtime", "idle_backoff_max", 0.02,
+            help="max seconds an idle worker sleeps between scheduler "
+                 "polls (wakeups are notify-driven; this caps staleness "
+                 "of polled fallbacks)")
         #: exclusive ownership of execution stream 0 (the "master" stream):
         #: contended between a wait()-ing thread and non-worker helpers
         self._es0_lock = threading.Lock()
@@ -235,7 +249,7 @@ class Context:
                     if done():
                         return True
                     self._cv.wait(backoff)
-                backoff = min(backoff * 2, 1e-3)
+                backoff = min(backoff * 2, self._idle_backoff_max)
         finally:
             if own_es0:
                 self._tls.es = None
@@ -286,7 +300,7 @@ class Context:
                     if self._shutdown:
                         return
                     self._cv.wait(backoff)
-                backoff = min(backoff * 2, 1e-3)
+                backoff = min(backoff * 2, self._idle_backoff_max)
                 continue
             backoff = 1e-6
             self._run_task(es, task)

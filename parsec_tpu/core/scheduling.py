@@ -40,7 +40,11 @@ def schedule_ready(context: "Context", es: Optional["ExecutionStream"], tasks: I
         es.next_task = batch.pop(best)
     if batch:
         context.scheduler.schedule(es, batch, distance)
-    context._notify_work()
+        # only a task actually pushed to the scheduler warrants waking the
+        # idle threads: a kept-next successor is run by THIS worker, and
+        # waking everyone per completion makes the idle pack churn the
+        # GIL against the running worker's async device dispatch
+        context._notify_work()
     pins.fire(pins.SCHEDULE_END, es, batch)
 
 

@@ -287,11 +287,46 @@ class TpuDevice(Device):
                 dev_args.append(jax.device_put(jnp.zeros(shape, dtype), self.jdev))
             # other kinds (e.g. "ctl") contribute no argument
 
-        key = getattr(body, "_jit_key", body)
-        jitted = self._jit_cache.get(key)
-        if jitted is None:
-            jitted = self._jit_cache[key] = jax.jit(body)
-        outputs = jitted(*dev_args)
+        base_key = getattr(body, "_jit_key", body)
+        # opt-in body attributes (set by the DSL body author):
+        #   _static_values — bake the task's VALUE args (its locals) into
+        #     the traced program as Python constants, one compile per
+        #     distinct value tuple: the per-parameter specialization that
+        #     lets a body use exact static shapes (slices sized by k).
+        #     The analogue of jdf2c's parameter-specialised generated code.
+        #   _donate_args — donate these positional array args to XLA so
+        #     in-place updates alias instead of allocating (a whole-matrix
+        #     INOUT flow would otherwise hold one fresh HBM buffer per
+        #     enqueued async step).
+        donate = tuple(getattr(body, "_donate_args", ()) or ())
+        if getattr(body, "_static_values", False):
+            # only arg-contributing kinds count ("ctl" adds no dev_arg)
+            specs = [s[0] for s in (task.body_args or ())
+                     if s[0] in ("data", "value", "scratch")]
+            nval = specs.count("value")
+            if nval and "value" in specs[:len(specs) - nval]:
+                # PTG orders flows-then-values; DTD interleaves user args —
+                # a suffix split would bake the WRONG args into the trace
+                raise RuntimeError(
+                    f"_static_values body of {task!r}: value args must "
+                    "trail all data args (PTG layout); this task "
+                    f"interleaves them ({specs})")
+            split = len(dev_args) - nval
+            arr_args, vals = dev_args[:split], tuple(dev_args[split:])
+            key = (base_key, vals)
+            jitted = self._jit_cache.get(key)
+            if jitted is None:
+                def _bound(*arrs, _body=body, _vals=vals):
+                    return _body(*arrs, *_vals)
+                jitted = self._jit_cache[key] = jax.jit(
+                    _bound, donate_argnums=donate)
+            outputs = jitted(*arr_args)
+        else:
+            jitted = self._jit_cache.get(base_key)
+            if jitted is None:
+                jitted = self._jit_cache[base_key] = jax.jit(
+                    body, donate_argnums=donate)
+            outputs = jitted(*dev_args)
         if not isinstance(outputs, (tuple, list)):
             outputs = (outputs,)
         outputs = list(outputs)
@@ -409,11 +444,12 @@ class TpuDevice(Device):
         else:
             self.hbm_used -= self._accounted.pop(data.data_id, 0)
 
-    def _drop_copy(self, data: Data) -> None:
+    def _drop_copy(self, data: Data, *, evicted: bool = True) -> None:
         c = data.detach_copy(self.data_index)
         if c is not None:
             self._hbm_free(data, c.nbytes)
-            self.stats["evictions"] += 1
+            if evicted:
+                self.stats["evictions"] += 1
 
     def _writeback(self, data: Data) -> None:
         """Write-back-to-rest eviction of a dirty tile (reference w2r tasks,
@@ -498,6 +534,19 @@ class TpuDevice(Device):
                             data, dirty=mine.coherency is Coherency.OWNED)
         else:
             super().data_advise(data, advice)
+
+    def drop_residency(self, data: Data) -> None:
+        """Release ``data``'s residency slot WITHOUT a host write-back:
+        ownership of the device array passes to the caller (who already
+        holds the payload).  The counterpart of the reference's
+        data_advise release path for benchmark/driver code that reads a
+        result and hands the buffer on — without this, every completed
+        run's output stays dirty-resident until LRU pressure forces a
+        full D2H write-back."""
+        with self._lock:
+            self._lru_clean.pop(data.data_id, None)
+            self._lru_dirty.pop(data.data_id, None)
+            self._drop_copy(data, evicted=False)  # handed over, not evicted
 
     # ------------------------------------------------------------------
     def resident_data(self, task: Task) -> int:

@@ -660,7 +660,7 @@ class PTGTaskpool(Taskpool):
             # space, counts are final — just pick the sources
             out = []
             for pc in self.ptg.classes.values():
-                undefined = 0
+                undefined = claimed = 0
                 for loc in self._local_space(pc):
                     if pc.goal_of(loc, self.constants) != 0:
                         continue
@@ -671,8 +671,8 @@ class PTGTaskpool(Taskpool):
                         # dynamic guards a producer release can race this scan
                         out.append(self._make_task(pc, loc))
                     else:
-                        undefined += 1  # a producer beat the scan to it
-                self._warn_undefined(pc, undefined)
+                        claimed += 1  # a producer beat the scan to it: fine
+                self._warn_undefined(pc, undefined, claimed)
             return out
 
         # chunked startup (the default): ONE pass over the task space per
@@ -695,7 +695,7 @@ class PTGTaskpool(Taskpool):
             cached: List[Tuple] = []
             ready: List[Task] = []
             pending = 0
-            undefined = 0
+            undefined = claimed = 0
             for loc in pc.param_space(self.constants):
                 if pc.rank_of(loc, self.constants) != myrank:
                     continue
@@ -707,7 +707,7 @@ class PTGTaskpool(Taskpool):
                     elif self._claim_source(pc.name, loc):
                         ready.append(self._make_task(pc, loc))
                     else:
-                        undefined += 1  # a producer beat the scan to it
+                        claimed += 1  # a producer beat the scan to it: fine
                 if pending >= self.STARTUP_CHUNK:
                     # count BEFORE scheduling: a chunk task retiring
                     # instantly must never see an unaccounted self
@@ -721,7 +721,7 @@ class PTGTaskpool(Taskpool):
             if ready:
                 scheduling.schedule_ready(context, None, ready)
             self._local_cache[pc.name] = cached
-            self._warn_undefined(pc, undefined)
+            self._warn_undefined(pc, undefined, claimed)
         return []
 
     def _claim_source(self, name: str, locs: Tuple) -> bool:
@@ -737,10 +737,11 @@ class PTGTaskpool(Taskpool):
             self._source_claims.add(key)
             return True
 
-    def _warn_undefined(self, pc: PTGTaskClass, undefined: int) -> None:
-        if undefined:
-            from ..utils import debug
+    def _warn_undefined(self, pc: PTGTaskClass, undefined: int,
+                        claimed: int = 0) -> None:
+        from ..utils import debug
 
+        if undefined:
             # goal 0 but some readable flow had no matched input dep:
             # legitimate with dynamic guards (a producer releases the
             # task later), a guaranteed hang if the guards are static
@@ -749,6 +750,14 @@ class PTGTaskpool(Taskpool):
                 "%s: %d task(s) held back from startup — a readable "
                 "flow matched no input dep; if its guards are static, "
                 "add an explicit '<- NONE' fallback", pc.name, undefined)
+        if claimed:
+            # benign and expected under dynamic guards: a producer release
+            # scheduled these before the scan reached them — NOT a missing
+            # input dep, so keep it out of the '<- NONE' diagnostic
+            debug.verbose(
+                3, "ptg",
+                "%s: %d source task(s) already claimed by producer "
+                "releases during the startup scan", pc.name, claimed)
 
     def _is_startup(self, pc: PTGTaskClass, loc: Tuple,
                     goal_known_zero: bool = False) -> bool:
@@ -1114,7 +1123,12 @@ def _wrap_device_body(pc: PTGTaskClass, fn: Callable):
     # stable identity across taskpool instantiations: the device module's
     # jit cache keys on this so one XLA compile serves every taskpool
     # built from the same (body, flow-signature) pair
-    wrapped._jit_key = (fn, tuple(names))
+    wrapped._jit_key = getattr(fn, "_jit_key", (fn, tuple(names)))
+    # forward the device-module opt-ins (see TpuDevice._submit): local
+    # values baked statically into the trace / donated array positions
+    for attr in ("_static_values", "_donate_args"):
+        if hasattr(fn, attr):
+            setattr(wrapped, attr, getattr(fn, attr))
     return wrapped
 
 

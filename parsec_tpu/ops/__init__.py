@@ -4,8 +4,10 @@ from . import tiles
 from .cholesky import cholesky_ptg, run_cholesky
 from .lu import lu_ptg, run_lu
 from .panel_chol import PanelCholesky, WholeCholesky
+from .segmented_chol import SegmentedCholesky, segmented_cholesky_ptg
 from .qr import qr_ptg, run_qr
 
 __all__ = ["tiles", "cholesky_ptg", "run_cholesky", "lu_ptg", "run_lu",
            "PanelCholesky", "WholeCholesky",
+           "SegmentedCholesky", "segmented_cholesky_ptg",
            "qr_ptg", "run_qr"]

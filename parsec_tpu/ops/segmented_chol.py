@@ -1,0 +1,195 @@
+"""Panel-segmented Cholesky THROUGH the task runtime — the north-star path.
+
+``ops/panel_chol.WholeCholesky`` proved the compile-scaling law (O(panels)
+programs reach N>=16384 at full TFLOPS) but bypasses every piece of the
+framework: no taskpool, no scheduler, no device module.  This module puts
+the same law *inside* the runtime, the way the reference's generated code
+runs inside its scheduler hot loop (``/root/reference/parsec/scheduling.c:474``
+``__parsec_context_wait`` -> task execution; ``jdf2c.c`` emits O(task
+classes) code specialised by task parameters):
+
+* the PTG has ONE task class, ``panel(k)`` — a whole right-looking panel
+  step (potrf + trsm-as-gemm + strip-mined trailing update), the
+  *segment* granularity at which dispatch cost (O(NT) tasks) vanishes
+  against MXU time while compile stays O(panels);
+* the whole matrix threads through the chain as a single INOUT flow, so
+  the taskpool's dependency machinery, the scheduler, and the TPU device
+  module (stage-in, epilog rebinding, eager async lanes) execute every
+  step — ``tpu_eager_complete`` streams all NT programs onto the device
+  queue back-to-back, and XLA input-output aliasing (``_donate_args``)
+  keeps HBM at ONE matrix + one step's temporaries;
+* each task's locals are baked into its trace (``_static_values``): the
+  body uses *exact* static shapes per step — no bucket padding, no
+  dynamic-slice copies of the trailing matrix, the same per-step program
+  WholeCholesky traces inline (panel_chol.py:191-221).
+
+Per step k (panel offset k0 = k*nb, trailing rows R = n-k0-nb):
+
+    L  = chol(A[k0:k0+nb, k0:k0+nb]);  W = inv(L)     # tiny, off-MXU
+    P  = A[k0+nb:, k0:k0+nb] @ W.T                    # panel trsm as gemm
+    A[k0+nb:, c0:c0+w] -= P @ P[c0-rows].T            # strip-mined update
+
+``bf16=True`` feeds the gemm operands in bfloat16 with f32 accumulation
+(same recipe and numerics class as the Pallas graph path and XLA's
+default TPU matmul precision).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.lifecycle import AccessMode
+from ..dsl.ptg import PTG
+
+try:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+except Exception:  # pragma: no cover
+    jax = None
+
+INOUT = AccessMode.INOUT
+
+
+def _make_panel_body(n: int, nb: int, bf16: bool, strip: int, kt: int):
+    """Whole-matrix panel-step device body.  ``k`` arrives as a VALUE arg
+    that the device module bakes statically (``_static_values``), so every
+    slice below has exact static shape — one XLA program per step, the
+    mirror of WholeCholesky's inline step trace.
+
+    ``kt`` is the fused-tail boundary: task ``kt`` runs ALL remaining
+    panels in one program.  The tail panels are tiny (device time below
+    per-program enqueue latency), so as separate tasks they would starve
+    the device on dispatch gaps — the same granularity-coarsening call
+    the reference makes with recursive tasks on small trailing blocks
+    (``/root/reference/parsec/recursive.h``)."""
+
+    def step(M, k):
+        k0 = k * nb
+        f32 = M.dtype
+        D = M[k0:k0 + nb, k0:k0 + nb]
+        L = jnp.linalg.cholesky(D)
+        # trsm-as-matmul: invert the nb x nb factor once (off the MXU)
+        # and turn the panel solve into one MXU gemm (BASELINE.md)
+        W = lax.linalg.triangular_solve(
+            L, jnp.eye(nb, dtype=f32), lower=True, left_side=True)
+        M = M.at[k0:k0 + nb, k0:k0 + nb].set(jnp.tril(L))
+        R = n - k0 - nb
+        if R == 0:
+            return M
+        P = M[k0 + nb:, k0:k0 + nb]
+        if bf16:
+            Pn = jnp.matmul(P.astype(jnp.bfloat16), W.T.astype(jnp.bfloat16),
+                            preferred_element_type=f32)
+        else:
+            Pn = P @ W.T
+        M = M.at[k0 + nb:, k0:k0 + nb].set(Pn)
+        Pl = Pn.astype(jnp.bfloat16) if bf16 else Pn
+        # strip-mined symmetric update: bounds per-step temporaries to
+        # R x strip so async-enqueued steps coexist in HBM
+        for c0 in range(k0 + nb, n, strip):
+            w = min(strip, n - c0)
+            Pj = Pl[c0 - (k0 + nb):c0 - (k0 + nb) + w, :]
+            if bf16:
+                upd = jnp.matmul(Pl, Pj.T, preferred_element_type=f32)
+            else:
+                upd = Pl @ Pj.T
+            M = M.at[k0 + nb:, c0:c0 + w].add(-upd)
+        return M
+
+    def panel(M, k):
+        k = int(k)  # static under _static_values
+        if k < kt:
+            return step(M, k)
+        for kk in range(kt, n // nb):  # fused tail: one program
+            M = step(M, kk)
+        return M
+
+    panel._static_values = True
+    panel._donate_args = (0,)  # the matrix updates in place on device
+    panel._jit_key = ("segchol_panel", n, nb, bf16, strip, kt)
+    return panel
+
+
+def segmented_cholesky_ptg(n: int, nb: int, *, bf16: bool = False,
+                           strip: int = 4096, tail: int = 4096) -> PTG:
+    """Build the panel-segmented dpotrf PTG.  Instantiate with
+    ``.taskpool(NT=KT+1, A=collection)`` — use :func:`n_segments` — where
+    ``A(0)`` holds the full n x n SPD matrix; the factorization happens
+    in place (lower).  ``tail`` fuses the final panels (trailing size
+    <= tail) into the last task; 0 disables fusing."""
+    if n % nb:
+        raise ValueError(f"N={n} not divisible by nb={nb}")
+    strip = min(strip, n)
+    if strip % nb:
+        raise ValueError(f"strip {strip} must be a multiple of nb {nb}")
+    kt = n_segments(n, nb, tail) - 1  # single source of truth for the
+    # fused-tail boundary: NT and the baked kt must never desync
+    ptg = PTG("dpotrf_seg")
+    panel = ptg.task_class("panel", k="0 .. NT-1")
+    panel.affinity("A(0)")
+    panel.priority("NT - k")  # panel order IS the critical path
+    panel.flow("M", INOUT,
+               "<- (k == 0) ? A(0) : M panel(k-1)",
+               "-> (k == NT-1) ? A(0) : M panel(k+1)")
+    panel.body(tpu=_make_panel_body(n, nb, bf16, strip, kt))
+    return ptg
+
+
+def n_segments(n: int, nb: int, tail: int = 4096) -> int:
+    """Task count of the segmented PTG: panels before the fused-tail
+    boundary, plus the one tail task."""
+    nt = n // nb
+    kt = max(0, nt - max(1, tail // nb)) if tail else nt - 1
+    return kt + 1
+
+
+class SegmentedCholesky:
+    """Convenience driver: run the segmented PTG through a live Context.
+
+    Builds a fresh taskpool per ``run`` (the runtime cost being measured
+    includes attach/enumeration/dispatch); the matrix stays device-resident
+    across steps via the device module's stage-in/epilog path."""
+
+    def __init__(self, context, n: int, nb: int, *, bf16: bool = False,
+                 strip: int = 4096, tail: int = 4096):
+        self.context = context
+        self.n, self.nb = n, nb
+        self.nt_tasks = n_segments(n, nb, tail)
+        self.ptg = segmented_cholesky_ptg(n, nb, bf16=bf16, strip=strip,
+                                          tail=tail)
+        self.device = next(
+            (d for d in context.devices if d.mca_name == "tpu"), None)
+        if self.device is None:
+            raise RuntimeError("segmented cholesky needs the tpu device module")
+
+    def run(self, A_dev, *, timeout: Optional[float] = 600):
+        """Factorize a device-resident (n, n) array through the runtime.
+        ``A_dev`` is donated step-by-step; returns the device result."""
+        from ..data import LocalCollection
+
+        dc = LocalCollection("A", shape=(self.n, self.n),
+                             dtype=np.dtype(A_dev.dtype.name))
+        d = dc.data_of(0)
+        c = d.attach_copy(self.device.data_index, A_dev)
+        c.version = d.newest_copy().version  # device copy is current
+        tp = self.ptg.taskpool(NT=self.nt_tasks, A=dc)
+        self.context.add_taskpool(tp)
+        if not tp.wait(timeout=timeout):
+            raise RuntimeError("segmented dpotrf did not quiesce")
+        out = d.get_copy(self.device.data_index)
+        if out is None or out.payload is None:  # pragma: no cover
+            raise RuntimeError("segmented dpotrf left no device result")
+        payload = out.payload
+        # the collection dies with this call: release the result's
+        # residency slot (no write-back) or repeated runs accumulate
+        # dirty tiles until LRU pressure forces full-matrix D2H flushes
+        self.device.drop_residency(d)
+        return payload
+
+    def __call__(self, A_np: np.ndarray) -> np.ndarray:
+        A = jax.device_put(jnp.asarray(np.ascontiguousarray(A_np)),
+                           self.device.jdev)
+        return np.tril(np.asarray(jax.device_get(self.run(A))))
