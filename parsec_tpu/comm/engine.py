@@ -23,7 +23,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, Optional, TYPE_CHECKING
 
-from ..utils import Component
+from ..utils import Component, debug
 
 if TYPE_CHECKING:  # pragma: no cover
     from ..core.context import Context
@@ -71,6 +71,53 @@ class CommEngine(Component):
 
     def send_am(self, tag: int, dst_rank: int, payload: Any) -> None:
         raise NotImplementedError
+
+    # -- piggyback channel (reference termdet.h:153-232: termination-
+    # detection state rides APPLICATION messages; dedicated waves are the
+    # idle-time fallback only) -------------------------------------------
+    #: provider() -> small picklable state or None, stamped on every
+    #: outgoing frame; consumer(src_rank, state) runs per received frame
+    _pb_provider: Optional[Callable[[], Any]] = None
+    _pb_consumer: Optional[Callable[[int, Any], None]] = None
+
+    def set_piggyback(self, provider: Optional[Callable[[], Any]],
+                      consumer: Optional[Callable[[int, Any], None]]) -> None:
+        """Install the piggyback channel.  The state must be tiny (it
+        travels on EVERY frame) and monotonic/self-describing (frames can
+        be reordered relative to the wave protocol)."""
+        self._pb_provider = provider
+        self._pb_consumer = consumer
+
+    def _pb_outgoing(self) -> Any:
+        if self._pb_provider is None:
+            return None
+        try:
+            return self._pb_provider()
+        except Exception as e:  # a broken provider must not kill sends
+            debug.error("piggyback provider raised: %s", e)
+            return None
+
+    def _pb_incoming(self, src_rank: int, state: Any) -> None:
+        if state is None or self._pb_consumer is None:
+            return
+        try:
+            self._pb_consumer(src_rank, state)
+        except Exception as e:
+            debug.error("piggyback consumer raised: %s", e)
+
+    # -- distributed-termdet message accounting (the four counters):
+    # every non-TERMDET message is counted at the CE boundary on both
+    # sides, so a wave observing idle ranks with sent != recv knows a
+    # message is still in flight (reference termdet.h:153-232)
+    def _termdet_note_sent(self, tag: int) -> None:
+        t = getattr(self, "_termdet_bound", None)
+        if t is not None and tag != 3:  # TAG_TERMDET
+            t.note_message_sent()
+
+    def _termdet_note_recv(self, tag: int) -> None:
+        t = getattr(self, "_termdet_bound", None)
+        if t is not None and tag != 3:
+            t.note_message_recv()
 
     # -- one-sided ------------------------------------------------------
     def mem_register(self, handle: Any, buffer: Any, once: bool = False,

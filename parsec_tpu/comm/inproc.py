@@ -25,7 +25,14 @@ from .engine import CommEngine, MAX_AM_TAGS
 
 
 def _wire_copy(obj: Any) -> Any:
-    """Copy numpy payloads crossing the fake wire."""
+    """Copy numpy payloads crossing the fake wire.  ``jax.Array``s pass
+    through UNCOPIED: they are immutable, so ranks cannot alias writable
+    memory through them — this is the device-native payload path (the
+    receiver lands them with a direct device_put, no host bounce)."""
+    from .payload import is_device_array
+
+    if is_device_array(obj):
+        return obj
     if isinstance(obj, np.ndarray):
         return obj.copy()
     if isinstance(obj, tuple):
@@ -64,6 +71,8 @@ class InprocFabric:
 class InprocComm(CommEngine):
     mca_name = "inproc"
     mca_priority = 10
+    #: same-process fabric: device payloads cross without serialization
+    device_payloads = True
 
     def __init__(self, fabric: InprocFabric, rank: int):
         self.fabric = fabric
@@ -83,7 +92,9 @@ class InprocComm(CommEngine):
     def send_am(self, tag: int, dst_rank: int, payload: Any) -> None:
         self.stats[f"am_sent_{tag}"] += 1
         self.stats["am_bytes"] += _payload_bytes(payload)
-        self.fabric.inboxes[dst_rank].put((tag, self.rank, _wire_copy(payload)))
+        self._termdet_note_sent(tag)
+        self.fabric.inboxes[dst_rank].put(
+            (tag, self.rank, _wire_copy(payload), self._pb_outgoing()))
         peer = self.fabric.engines[dst_rank]
         if peer is not None and peer.context is not None:
             peer.context._notify_work()
@@ -132,9 +143,11 @@ class InprocComm(CommEngine):
             inbox = self.fabric.inboxes[self.rank]
             while True:
                 try:
-                    tag, src, payload = inbox.get_nowait()
+                    tag, src, payload, pb = inbox.get_nowait()
                 except queue.Empty:
                     break
+                self._pb_incoming(src, pb)
+                self._termdet_note_recv(tag)
                 cb = self._am.get(tag)
                 if cb is None:
                     debug.warning("rank %d: AM on unregistered tag %d", self.rank, tag)
@@ -157,7 +170,7 @@ class InprocComm(CommEngine):
 
 
 def _payload_bytes(obj: Any) -> int:
-    if isinstance(obj, np.ndarray):
+    if isinstance(obj, np.ndarray) or hasattr(obj, "nbytes"):
         return obj.nbytes
     if isinstance(obj, (tuple, list)):
         return sum(_payload_bytes(o) for o in obj)

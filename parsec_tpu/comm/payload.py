@@ -1,0 +1,52 @@
+"""Wire-payload helpers: the device-native data path (SURVEY §5.8,
+round-2 VERDICT Missing #5).
+
+The reference's comm engine moves GPU buffers without a host bounce when
+the fabric allows (``parsec_comm_engine.h:176-199`` is the vtable seam
+for device-aware backends).  The TPU equivalents here:
+
+* **device-capable transports** (``CommEngine.device_payloads = True``,
+  e.g. the in-process fabric): ``jax.Array`` payloads cross the wire
+  UNCOPIED — they are immutable, so sharing is safe — and the receiver
+  lands them with a direct ``jax.device_put`` onto its own chip: a
+  device-to-device transfer (ICI-class on real multi-chip hardware),
+  never touching host numpy;
+* **serializing transports** (TCP): exactly one D2H per payload, and
+  when an activation carries several flows their transfers are issued
+  ASYNC first (``copy_to_host_async``) so the D2H copies overlap instead
+  of serializing — then each materializes via the normal buffer protocol.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import numpy as np
+
+try:
+    import jax
+
+    _JaxArray = jax.Array
+except Exception:  # pragma: no cover - jax always present in this image
+    jax = None
+    _JaxArray = ()
+
+
+def is_device_array(obj) -> bool:
+    return jax is not None and isinstance(obj, _JaxArray)
+
+
+def prefetch_to_host(arrs: Iterable) -> None:
+    """Start async D2H for every device payload; the later ``to_wire``
+    conversions then complete already-overlapped transfers."""
+    for a in arrs:
+        if is_device_array(a):
+            try:
+                a.copy_to_host_async()
+            except Exception:
+                pass  # backend without async copy: to_wire still works
+
+
+def to_wire(arr) -> np.ndarray:
+    """One D2H (or zero-copy alias on the CPU backend) to wire form."""
+    return np.asarray(arr)

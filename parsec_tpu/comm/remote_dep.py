@@ -252,12 +252,15 @@ class RemoteDepManager:
         lands (reference analog: the data-collection write side of
         release_deps, DTD's data_flush for the dynamic case).
         ``payload=None`` is a pure retire for a counted-but-dataless flow."""
+        if payload is not None and not getattr(self.ce, "device_payloads",
+                                               False):
+            payload = np.asarray(payload)  # serialize for the wire
         msg = {
             "pool": tp.name,
             "kind": "writeback",
             "collection": collection_name,
             "key": tuple(key),
-            "data": np.asarray(payload) if payload is not None else None,
+            "data": payload,
         }
         self.stats["writebacks_sent"] += 1
         self.ce.send_am(TAG_ACTIVATE, dst_rank, msg)

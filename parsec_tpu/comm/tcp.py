@@ -74,7 +74,7 @@ TAG_GET_ANS = MAX_AM_TAGS - 1     # 11
 _HDR = struct.Struct("!HHII")
 _BUFLEN = struct.Struct("!Q")
 _MAGIC = 0x9A7C
-_WIRE_VERSION = 2
+_WIRE_VERSION = 3  # v3: control blob = (rank, batch, piggyback-or-None)
 _RANK = struct.Struct("!i")
 _MISSING = object()
 #: protocol constant: out-of-band buffers one frame may carry; the
@@ -334,6 +334,7 @@ class TCPComm(CommEngine):
             # self-sends short-circuit (reference delivers locally too)
             self._dispatch(tag, self.rank, payload)
             return
+        self._termdet_note_sent(tag)
         self._cmds.put((dst_rank, tag, payload))
         try:
             self._wake_w.send(b"\0")
@@ -556,7 +557,7 @@ class TCPComm(CommEngine):
         # as raw zero-copy memoryviews appended after the blob
         bufs: List[memoryview] = []
         blob = pickle.dumps(
-            (self.rank, _pack_arrays(batch, self.stats)),
+            (self.rank, _pack_arrays(batch, self.stats), self._pb_outgoing()),
             protocol=5,
             buffer_callback=lambda pb: bufs.append(pb.raw()) and None)
         head = (_HDR.pack(_MAGIC, _WIRE_VERSION, len(blob), len(bufs))
@@ -759,12 +760,14 @@ class TCPComm(CommEngine):
             holders.append(holder)
             views.append(memoryview(holder))
         try:
-            src, batch = pickle.loads(st.ctl, buffers=views)
+            src, batch, pb = pickle.loads(st.ctl, buffers=views)
         except Exception as e:
             debug.error("rank %d: undecodable frame: %s", self.rank, e)
             return 0  # finalizers recycle the slots as holders die
         finally:
             del views, holders  # only consumer chains keep slots alive now
+        self._pb_incoming(src, pb)  # state first: it describes the sender
+        # as of (at latest) this frame's messages
         n = 0
         for tag, payload in batch:
             self._dispatch(tag, src, payload)
@@ -790,6 +793,8 @@ class TCPComm(CommEngine):
                 pass
 
     def _dispatch(self, tag: int, src: int, payload: Any) -> None:
+        if src != self.rank:
+            self._termdet_note_recv(tag)  # self-sends count on neither side
         with self._am_lock:
             cb = self._am.get(tag)
             if cb is None:

@@ -155,6 +155,22 @@ class Context:
             tp.on_enqueue(tp)
         if self.comm is not None:
             self.comm.new_taskpool(tp)
+            # distributed termdet monitors (fourcounter) bind to the comm
+            # engine here and are driven from the idle loop
+            # (_progress_comm); one distributed monitor per CE at a time
+            # — the TERMDET tag and piggyback channel are single-slot
+            tdm = tp.tdm
+            if hasattr(tdm, "bind") and getattr(tdm, "ce", None) is None:
+                bound = getattr(self.comm, "_termdet_bound", None)
+                if bound is None:
+                    tdm.bind(self.comm)
+                    self.comm._termdet_bound = tdm
+                else:
+                    debug.warning(
+                        "taskpool %s: comm engine already carries a "
+                        "distributed termdet monitor; %s falls back to "
+                        "unbound (one fourcounter pool at a time)",
+                        tp.name, type(tdm).__name__)
         # hold a runtime action across ready+startup so an empty-looking pool
         # cannot declare termination before its startup tasks are accounted
         tp.tdm.taskpool_addto_runtime_actions(tp, 1)
@@ -344,6 +360,9 @@ class Context:
     def _progress_comm(self) -> None:
         if self.comm is not None:
             self.comm.progress_nonblocking()
+            tdm = getattr(self.comm, "_termdet_bound", None)
+            if tdm is not None:
+                tdm.idle_progress()  # rank 0 wave driver (rate-limited)
 
     def current_es(self) -> Optional[ExecutionStream]:
         return getattr(self._tls, "es", None)

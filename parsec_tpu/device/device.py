@@ -47,6 +47,7 @@ class Device(Component):
             "executed_tasks": 0,
             "bytes_in": 0,
             "bytes_out": 0,
+            "bytes_d2d": 0,  # device-to-device landings (no host bounce)
             "evictions": 0,
         }
         self.enabled = True

@@ -299,6 +299,13 @@ class TpuDevice(Device):
         #     INOUT flow would otherwise hold one fresh HBM buffer per
         #     enqueued async step).
         donate = tuple(getattr(body, "_donate_args", ()) or ())
+        if donate and getattr(self.context, "nranks", 1) > 1:
+            # device-capable fabrics ship jax.Arrays UNCOPIED across
+            # ranks (comm/payload.py): donating a buffer a peer may still
+            # read would invalidate it under them.  Until donation is
+            # remote-successor-aware, multirank runs fall back to
+            # functional (non-aliasing) execution.
+            donate = ()
         if getattr(body, "_static_values", False):
             # only arg-contributing kinds count ("ctl" adds no dev_arg)
             specs = [s[0] for s in (task.body_args or ())
@@ -366,14 +373,22 @@ class TpuDevice(Device):
             return mine.payload
         if newest is None:
             raise RuntimeError(f"{data!r}: no valid copy to stage in")
-        host = np.asarray(newest.payload)
         # re-staging over a stale device copy replaces it: account the delta
         old = mine.nbytes if (mine is not None and mine.payload is not None) else 0
-        self._hbm_realloc(data, old, host.nbytes)
-        arr = jax.device_put(host, self.jdev)
+        if isinstance(newest.payload, jax.Array):
+            # device-resident arrival (device-capable fabric): land it
+            # with a direct device_put — device-to-device, ICI-class on
+            # multi-chip, no host numpy bounce (SURVEY §5.8)
+            self._hbm_realloc(data, old, newest.payload.nbytes)
+            arr = jax.device_put(newest.payload, self.jdev)
+            self.stats["bytes_d2d"] += newest.payload.nbytes
+        else:
+            host = np.asarray(newest.payload)
+            self._hbm_realloc(data, old, host.nbytes)
+            arr = jax.device_put(host, self.jdev)
+            self.stats["bytes_in"] += host.nbytes
         c = data.attach_copy(self.data_index, arr)
         c.version = newest.version
-        self.stats["bytes_in"] += host.nbytes
         self._lru_touch(data, dirty=False)
         return arr
 

@@ -202,4 +202,9 @@ def source_tile(g: TaskGraph, tid: TaskId, flow_name: str):
         if src[0] == "new":
             return ("new", cur, cflow)
         _, ptid, pflow = src
+        if ptid not in g.nodes:
+            # the chain leaves a rank-filtered capture: the flow's value
+            # arrives from a REMOTE producer (native_dist resolves these
+            # from deposited activation payloads)
+            return ("remote", ptid, pflow)
         cur, cflow = ptid, pflow

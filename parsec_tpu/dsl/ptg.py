@@ -509,10 +509,11 @@ class PTG:
         self.classes[name] = c
         return c
 
-    def taskpool(self, **constants: Any) -> "PTGTaskpool":
+    def taskpool(self, termdet: Optional[str] = None,
+                 **constants: Any) -> "PTGTaskpool":
         merged = dict(self.constants)
         merged.update(constants)
-        return PTGTaskpool(self, merged)
+        return PTGTaskpool(self, merged, termdet=termdet)
 
 
 # ---------------------------------------------------------------------------
@@ -520,8 +521,9 @@ class PTG:
 # ---------------------------------------------------------------------------
 
 class PTGTaskpool(Taskpool):
-    def __init__(self, ptg: PTG, constants: Dict[str, Any]):
-        super().__init__(name=ptg.name)
+    def __init__(self, ptg: PTG, constants: Dict[str, Any],
+                 termdet: Optional[str] = None):
+        super().__init__(name=ptg.name, termdet=termdet)
         self.taskpool_type = Taskpool.TYPE_PTG
         self.ptg = ptg
         self.constants = constants
@@ -923,7 +925,9 @@ class PTGTaskpool(Taskpool):
                                     and f.index not in flow_payloads):
                                 src = data.newest_copy()
                                 if src is not None:
-                                    flow_payloads[f.index] = np.asarray(src.payload)
+                                    # raw (possibly device-resident):
+                                    # converted for the transport below
+                                    flow_payloads[f.index] = src.payload
                             continue
                         if f.mode != CTL:
                             if entry is None:
@@ -942,6 +946,16 @@ class PTGTaskpool(Taskpool):
                     raise RuntimeError(
                         f"task {task!r} has remote successors on ranks "
                         f"{sorted(rank_masks)} but the context has no comm engine")
+                if not getattr(comm, "device_payloads", False):
+                    # serializing transport: overlap the D2H copies of
+                    # every device-resident flow, then convert once each
+                    # (device-capable fabrics ship jax.Arrays untouched —
+                    # the receiver lands them device-to-device)
+                    from ..comm.payload import prefetch_to_host, to_wire
+
+                    prefetch_to_host(flow_payloads.values())
+                    flow_payloads = {k: to_wire(v)
+                                     for k, v in flow_payloads.items()}
                 comm.remote_dep.send_activations(
                     self, pc.name, task.locals, rank_masks, flow_payloads)
             ready: List[Task] = []
@@ -971,7 +985,7 @@ class PTGTaskpool(Taskpool):
                 src = data.newest_copy() if data is not None else None
                 self.context.comm.remote_dep.send_writeback(
                     self, t.collection_name, key,
-                    np.asarray(src.payload) if src is not None else None,
+                    src.payload if src is not None else None,
                     owner)
                 return
         if data is None:
@@ -1085,9 +1099,8 @@ class PTGTaskpool(Taskpool):
                             if entry is None:
                                 entry = repo.lookup_and_create(src_locals)
                             if entry.copies[f.index] is None:
-                                entry.copies[f.index] = data_create(
-                                    (src_class, src_locals, f.index),
-                                    payload=payload)
+                                entry.copies[f.index] = self._deposit_payload(
+                                    (src_class, src_locals, f.index), payload)
                             deposited = True
                         nb_consumers += 1
                     goal = succ_pc.goal_of(locs, self.constants)
@@ -1100,6 +1113,30 @@ class PTGTaskpool(Taskpool):
             repo.set_usage_limit(src_locals, nb_consumers)
         if ready and self.context is not None:
             self.context.schedule(ready, es=self.context.current_es())
+
+    def _deposit_payload(self, key, payload):
+        """Land an arrived flow payload.  Device-resident arrivals (a
+        device-capable fabric shipped a ``jax.Array``) go STRAIGHT onto
+        this rank's chip — a device_put from the producer's device is a
+        direct device-to-device transfer (ICI-class on multi-chip; no
+        host numpy anywhere, SURVEY §5.8).  Host arrivals attach as the
+        CPU copy exactly as before."""
+        from ..comm.payload import is_device_array
+
+        if is_device_array(payload) and self.context is not None:
+            dev = next((d for d in self.context.devices
+                        if d.mca_name == "tpu"), None)
+            if dev is not None:
+                import jax
+
+                arr = jax.device_put(payload, dev.jdev)
+                d = data_create(key)
+                c = d.attach_copy(dev.data_index, arr)
+                c.version = 1  # the only copy: newest by construction
+                d.shape, d.dtype = arr.shape, arr.dtype
+                dev.stats["bytes_d2d"] += payload.nbytes
+                return d
+        return data_create(key, payload=payload)
 
 
 # ---------------------------------------------------------------------------

@@ -5,9 +5,13 @@ from .cholesky import cholesky_ptg, run_cholesky
 from .lu import lu_ptg, run_lu
 from .panel_chol import PanelCholesky, WholeCholesky
 from .segmented_chol import SegmentedCholesky, segmented_cholesky_ptg
+from .segmented_lu import SegmentedLU, segmented_lu_ptg
+from .segmented_qr import SegmentedQR, segmented_qr_ptg
 from .qr import qr_ptg, run_qr
 
 __all__ = ["tiles", "cholesky_ptg", "run_cholesky", "lu_ptg", "run_lu",
            "PanelCholesky", "WholeCholesky",
            "SegmentedCholesky", "segmented_cholesky_ptg",
+           "SegmentedLU", "segmented_lu_ptg",
+           "SegmentedQR", "segmented_qr_ptg",
            "qr_ptg", "run_qr"]

@@ -53,6 +53,20 @@ except Exception:  # pragma: no cover
 INOUT = AccessMode.INOUT
 
 
+def _attach_device_matrix(device, name: str, arr):
+    """Create a one-element collection whose Data's CURRENT copy is the
+    device-resident array (the host zeros placeholder is never touched) —
+    the shared setup of every segmented-factorization driver."""
+    from ..data import LocalCollection
+
+    dc = LocalCollection(name, shape=tuple(arr.shape),
+                         dtype=np.dtype(arr.dtype.name))
+    d = dc.data_of(0)
+    c = d.attach_copy(device.data_index, arr)
+    c.version = d.newest_copy().version  # device copy is current
+    return d
+
+
 def _make_panel_body(n: int, nb: int, bf16: bool, strip: int, kt: int):
     """Whole-matrix panel-step device body.  ``k`` arrives as a VALUE arg
     that the device module bakes statically (``_static_values``), so every
@@ -168,14 +182,8 @@ class SegmentedCholesky:
     def run(self, A_dev, *, timeout: Optional[float] = 600):
         """Factorize a device-resident (n, n) array through the runtime.
         ``A_dev`` is donated step-by-step; returns the device result."""
-        from ..data import LocalCollection
-
-        dc = LocalCollection("A", shape=(self.n, self.n),
-                             dtype=np.dtype(A_dev.dtype.name))
-        d = dc.data_of(0)
-        c = d.attach_copy(self.device.data_index, A_dev)
-        c.version = d.newest_copy().version  # device copy is current
-        tp = self.ptg.taskpool(NT=self.nt_tasks, A=dc)
+        d = _attach_device_matrix(self.device, "A", A_dev)
+        tp = self.ptg.taskpool(NT=self.nt_tasks, A=d.collection)
         self.context.add_taskpool(tp)
         if not tp.wait(timeout=timeout):
             raise RuntimeError("segmented dpotrf did not quiesce")

@@ -50,6 +50,56 @@ def _spans(events: List[dict]) -> List[dict]:
     return iter_spans(events)
 
 
+def comm_overlap_fraction(events: List[dict], *, exec_name: str = "exec",
+                          comm_names=("comm_recv", "comm_send")):
+    """Comm/compute overlap from trace timestamps (the reference's
+    stencil overlap study, ``tests/apps/stencil/testing_stencil_1D.c`` —
+    overlap % is the headline metric of BASELINE.json's 64-chip config).
+
+    Exec busy time is the union of ``exec_name`` begin/end spans across
+    all streams; comm events (instants stamped at activation/payload
+    send/receive) that land INSIDE that union were serviced while
+    compute was running — i.e. their latency was hidden.  Returns
+    ``(overlap_fraction, n_comm_events, busy_us)``."""
+    open_: Dict[Any, float] = {}
+    intervals: List[tuple] = []
+    comm_ts: List[float] = []
+    for e in events:
+        name, ph = e.get("name"), e.get("ph")
+        if name == exec_name:
+            key = (e.get("pid"), e.get("tid"),
+                   e.get("args", {}).get("event_id"))
+            if ph == "B":
+                open_[key] = e["ts"]
+            elif ph == "E":
+                t0 = open_.pop(key, None)
+                if t0 is not None:
+                    intervals.append((t0, e["ts"]))
+        elif name in comm_names and ph == "i":
+            comm_ts.append(e["ts"])
+    # merge the busy intervals
+    intervals.sort()
+    merged: List[List[float]] = []
+    for a, b in intervals:
+        if merged and a <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], b)
+        else:
+            merged.append([a, b])
+    busy = sum(b - a for a, b in merged)
+    if not comm_ts:
+        return 0.0, 0, busy
+    import bisect
+
+    starts = [a for a, _ in merged]
+    ends = [b for _, b in merged]
+    inside = 0
+    for t in comm_ts:
+        i = bisect.bisect_right(starts, t) - 1
+        if i >= 0 and t <= ends[i]:
+            inside += 1
+    return inside / len(comm_ts), len(comm_ts), busy
+
+
 def cmd_info(args) -> int:
     doc = load(args.trace)
     evs = doc.get("traceEvents", [])

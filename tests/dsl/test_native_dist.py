@@ -1,0 +1,91 @@
+"""Native engine x comm composition (round-2 VERDICT Missing #7):
+distributed dpotrf where every rank's local partition runs on the C++
+engine and cross-rank deps ride the aggregated activation protocol."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu import native
+from parsec_tpu.comm import InprocFabric
+from parsec_tpu.datadist import TwoDimBlockCyclic
+from parsec_tpu.dsl.native_dist import NativeDistExecutor
+from parsec_tpu.ops import cholesky_ptg
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native core unavailable: {native.build_error()}")
+
+
+def _run_dist(nranks, p, q, N, nb, *, nthreads=2, timeout=60):
+    rng = np.random.default_rng(17)
+    M = rng.standard_normal((N, N))
+    SPD = M @ M.T + N * np.eye(N)
+    fabric = InprocFabric(nranks)
+    ces = fabric.endpoints()
+    mats, counts, errors = {}, {}, []
+
+    def worker(r):
+        try:
+            A = TwoDimBlockCyclic(N, N, nb, nb, p=p, q=q, myrank=r, name="A")
+            A.from_array(SPD)
+            mats[r] = A
+            tp = cholesky_ptg(use_tpu=False, use_cpu=True).taskpool(
+                NT=A.mt, A=A)
+            ex = NativeDistExecutor(tp, ces[r])
+            counts[r] = ex.run(nthreads=nthreads)
+        except Exception as e:  # pragma: no cover
+            import traceback
+
+            traceback.print_exc()
+            errors.append((r, e))
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(nranks)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=timeout)
+    assert not errors, errors
+    assert all(not t.is_alive() for t in ts), "distributed run hung"
+
+    out = np.zeros((N, N))
+    for r, A in mats.items():
+        for (i, j) in A.local_tiles():
+            d = A.data_of(i, j)
+            c = d.newest_copy()
+            out[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb] = c.payload
+    L_ref = np.linalg.cholesky(SPD)
+    err = np.max(np.abs(np.tril(out) - L_ref)) / np.max(np.abs(L_ref))
+    return counts, err, ces
+
+
+def test_native_dist_cholesky_4ranks():
+    """4 ranks, 2x2 block-cyclic grid: numerics match numpy, every rank
+    executed its exact local partition, and activations actually crossed
+    the wire (no rank fell back to running everything)."""
+    nranks, N, nb = 4, 128, 16
+    counts, err, ces = _run_dist(nranks, 2, 2, N, nb)
+    assert err < 1e-10, err
+    nt = N // nb
+    total = nt * (nt + 1) * (nt + 2) // 6  # potrf+trsm+syrk+gemm count
+    assert sum(counts.values()) == total, (counts, total)
+    assert all(counts[r] > 0 for r in range(nranks)), counts
+    acts = sum(ce.remote_dep.stats["activations_sent"] for ce in ces)
+    assert acts > 0
+    # aggregation held: one activation per (task, destination rank)
+    recv = sum(ce.remote_dep.stats["activations_recv"] for ce in ces)
+    assert recv == acts
+
+
+def test_native_dist_single_rank_degenerates():
+    """nranks=1: no phantoms, no sends — behaves as the plain executor."""
+    counts, err, ces = _run_dist(1, 1, 1, 64, 16)
+    assert err < 1e-10, err
+    assert ces[0].remote_dep.stats.get("activations_sent", 0) == 0
+
+
+def test_native_dist_uneven_grid():
+    """1x3 grid: column-heavy distribution with write-backs crossing
+    ranks in both directions."""
+    counts, err, _ = _run_dist(3, 1, 3, 96, 16, timeout=90)
+    assert err < 1e-10, err

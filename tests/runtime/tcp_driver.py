@@ -185,6 +185,46 @@ def scenario_dtd_gemm(ce):
     return stats
 
 
+def scenario_dist_dpotrf(ce):
+    """Distributed dpotrf over real TCP processes — the multi-rank
+    RUNTIME perf row (round-2 VERDICT item 3).  Config via env:
+    PERF_N, PERF_NB, PERF_P (grid rows; cols = nranks//P)."""
+    from parsec_tpu.datadist import TwoDimBlockCyclic
+    from parsec_tpu.ops import cholesky_ptg
+
+    N = int(os.environ.get("PERF_N", "512"))
+    nb = int(os.environ.get("PERF_NB", "32"))
+    p = int(os.environ.get("PERF_P", "1"))
+    q = max(1, ce.nranks // p)
+    rng = np.random.default_rng(3)
+    M = rng.standard_normal((N, N))
+    SPD = M @ M.T + N * np.eye(N)
+    ctx = Context(nb_cores=2, rank=ce.rank, nranks=ce.nranks, comm=ce)
+    A = TwoDimBlockCyclic(N, N, nb, nb, p=p, q=q, myrank=ce.rank, name="A")
+    A.from_array(SPD)
+    tp = cholesky_ptg(use_tpu=False, use_cpu=True).taskpool(NT=A.mt, A=A)
+    ce.barrier()  # synchronized start: elapsed is comparable across ranks
+    t0 = time.perf_counter()
+    ctx.add_taskpool(tp)
+    ok = tp.wait(timeout=600)
+    dt = time.perf_counter() - t0
+    assert ok, "dpotrf did not quiesce"
+    ce.barrier()
+    # spot-check: my local diagonal tiles match the reference factor
+    L = np.linalg.cholesky(SPD)
+    for (i, j) in A.local_tiles():
+        if i == j:
+            c = A.data_of(i, j).newest_copy()
+            np.testing.assert_allclose(
+                np.tril(np.asarray(c.payload)),
+                L[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb],
+                rtol=1e-6, atol=1e-8)
+    ctx.fini()
+    nt = N // nb
+    return {"elapsed": dt, "ntasks": nt * (nt + 1) * (nt + 2) // 6,
+            "acts": int(ce.remote_dep.stats.get("activations_sent", 0))}
+
+
 def main():
     scenario = sys.argv[1]
     ce = endpoint_from_env()

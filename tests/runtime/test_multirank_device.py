@@ -67,7 +67,11 @@ def test_distributed_cholesky_device_chores():
     for c in ctxs:
         dev = _tpu_of(c)
         assert dev.stats["executed_tasks"] > 0, f"rank {c.rank}: no device tasks"
-        assert dev.stats["bytes_in"] > 0, f"rank {c.rank}: nothing staged in"
+        # the inproc fabric is device-capable: cross-rank tiles land
+        # device-to-device (bytes_d2d); host staging covers the initial
+        # collection tiles
+        assert dev.stats["bytes_in"] + dev.stats["bytes_d2d"] > 0, \
+            f"rank {c.rank}: nothing staged in"
     # chips are distinct (rank -> chip binding under the real runtime)
     assert len({_tpu_of(c).jdev.id for c in ctxs}) == nranks
     # remote dataflow really happened (device tiles crossed the wire)
@@ -114,3 +118,46 @@ def test_distributed_mixed_cpu_device_chores():
             out[i * nb:i * nb + h, j * nb:j * nb + w] = np.asarray(c.payload)
     np.testing.assert_allclose(
         np.tril(out), np.linalg.cholesky(SPD), rtol=1e-6, atol=1e-6)
+
+
+def test_device_payload_path_no_host_bounce():
+    """SURVEY §5.8 / round-2 VERDICT Missing #5: on a device-capable
+    fabric a device-produced tile crosses ranks as a jax.Array and lands
+    with a direct device_put (bytes_d2d) — the flow payload never rides
+    host numpy.  Producer side ships the device array uncopied; consumer
+    deposits it straight onto its chip."""
+    import numpy as np
+
+    from parsec_tpu.data import LocalCollection
+    from parsec_tpu.dsl.ptg import PTG, IN, INOUT
+
+    nranks = 2
+    colls = {}
+
+    def build(rank, ctx):
+        dc = LocalCollection("D", shape=(32, 32), nodes=nranks, myrank=rank,
+                             init=lambda k: np.full((32, 32), 2.0, np.float32))
+        dc.rank_of = lambda *key: key[0] % nranks
+        colls[rank] = dc
+
+        ptg = PTG("d2d")
+        src = ptg.task_class("src")
+        src.affinity("D(0)")
+        src.flow("X", INOUT, "<- D(0)", "-> X sink(0)")
+        src.body(tpu=lambda X: X * 3.0)
+        sink = ptg.task_class("sink", i="0 .. 0")
+        sink.affinity("D(1)")
+        sink.flow("X", IN, "<- X src()")
+        sink.flow("Y", INOUT, "<- D(1)", "-> D(1)")
+        sink.body(tpu=lambda X, Y, i: X + Y)
+        return ptg.taskpool(D=dc)
+
+    ctxs = run_ranks(nranks, build, timeout=60)
+    dev1 = _tpu_of(ctxs[1])
+    # the cross-rank flow landed device-to-device...
+    assert dev1.stats["bytes_d2d"] == 32 * 32 * 4, dev1.stats
+    assert dev1.stats["executed_tasks"] == 1
+    # ...and the value is right: sink computed 2*3 + 2 = 8 into D(1)
+    from parsec_tpu.dsl.dtd import stage_to_cpu
+
+    np.testing.assert_allclose(stage_to_cpu(colls[1].data_of(1)), 8.0)

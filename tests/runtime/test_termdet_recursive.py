@@ -34,8 +34,9 @@ def test_fourcounter_waves_detect_quiescence():
         for ce in ces:
             ce.progress_nonblocking()
 
-    # all ranks busy: a wave must NOT conclude
-    mons[0].initiate_wave()
+    # all ranks busy: a wave must NOT conclude (force: this test drives
+    # the raw wave protocol; suppression is pinned in test_termdet_piggyback)
+    mons[0].initiate_wave(force=True)
     for _ in range(5):
         drain()
     assert not fired
@@ -44,7 +45,7 @@ def test_fourcounter_waves_detect_quiescence():
     mons[1].taskpool_addto_nb_tasks(tps[1], -1)
     mons[1].note_message_sent()
     mons[0].taskpool_addto_nb_tasks(tps[0], -1)
-    mons[0].initiate_wave()
+    mons[0].initiate_wave(force=True)
     for _ in range(5):
         drain()
     assert not fired  # rank2 busy + counts unbalanced
@@ -53,12 +54,12 @@ def test_fourcounter_waves_detect_quiescence():
     mons[2].note_message_recv()
     mons[2].taskpool_addto_nb_tasks(tps[2], -1)
     # first balanced wave: records totals, must not yet terminate
-    mons[0].initiate_wave()
+    mons[0].initiate_wave(force=True)
     for _ in range(5):
         drain()
     assert not fired
     # second identical balanced wave: terminate everywhere
-    mons[0].initiate_wave()
+    mons[0].initiate_wave(force=True)
     for _ in range(5):
         drain()
     assert sorted(fired) == [0, 1, 2]
@@ -218,3 +219,111 @@ def test_context_vpmap_param():
             assert [es.vp_id for es in ctx.streams] == [0, 1, 0, 1]
     finally:
         mca_param.params.unset("runtime", "vpmap")
+
+
+def test_termdet_piggyback_zero_dedicated_in_steady_state():
+    """The round-2 VERDICT bar: while application traffic flows, the
+    fourcounter sends ZERO dedicated termdet messages — its state rides
+    the app frames (CE piggyback channel), and waves against a
+    visibly-busy system are suppressed.  Dedicated traffic happens only
+    at the end: the confirming waves."""
+    fabric = InprocFabric(3)
+    ces = fabric.endpoints()
+    seen = []
+    for ce in ces:
+        ce.register_am(TAG_CTL, lambda src, p: seen.append((src, p)))
+    mons = [TermDetFourCounter().bind(ces[r]) for r in range(3)]
+    tps = [_FakeTp() for _ in range(3)]
+    fired = []
+    for r, m in enumerate(mons):
+        m.monitor_taskpool(tps[r], lambda tp, r=r: fired.append(r))
+        m.taskpool_set_nb_tasks(tps[r], 1)
+        m.taskpool_ready(tps[r])
+
+    def drain():
+        for ce in ces:
+            ce.progress_nonblocking()
+
+    # steady state: app messages flow while every rank is busy; the
+    # idle-driver keeps attempting waves — ALL must be suppressed
+    for step in range(6):
+        src, dst = step % 3, (step + 1) % 3
+        mons[src].note_message_sent()
+        ces[src].send_am(TAG_CTL, dst, {"step": step})
+        drain()
+        mons[dst].note_message_recv()
+        mons[0].initiate_wave()
+        drain()
+    assert sum(m.dedicated_sent for m in mons) == 0, \
+        [m.dedicated_sent for m in mons]
+    assert mons[0].waves_suppressed >= 6
+    # the piggybacked states actually arrived at rank 0 (ring topology:
+    # rank 0 receives app frames from rank 2 only)
+    assert 2 in mons[0]._peer_states
+    assert not fired
+
+    # everyone finishes; no more app traffic — the stale-picture valve
+    # lets waves through and the protocol concludes with dedicated
+    # traffic bounded by the confirming waves alone
+    for r, m in enumerate(mons):
+        m.taskpool_addto_nb_tasks(tps[r], -1)
+    for _ in range(8):
+        mons[0].initiate_wave()
+        # drain until quiet: a wave's replies must land before the next
+        # initiate_wave supersedes it (the idle driver's pace vs message
+        # latency; superseding semantics are pinned in the stale-wave test)
+        for _ in range(4):
+            drain()
+        if fired:
+            break
+    assert sorted(set(fired)) == [0, 1, 2]
+    # probes + replies + terminates for the concluding waves only:
+    # <= 3 waves x 2(R-1) + (R-1) terminates
+    total = sum(m.dedicated_sent for m in mons)
+    assert 0 < total <= 3 * 2 * 2 + 2, total
+
+
+def test_fourcounter_production_wiring_end_to_end():
+    """No manual driving: a 2-rank PTG chain with termdet='fourcounter'
+    binds to the comm engine at add_taskpool, counts app messages at the
+    CE boundary, and the idle loop's rate-limited wave driver concludes
+    termination — wait() returns True on both ranks."""
+    import numpy as np
+
+    from parsec_tpu import Context
+    from parsec_tpu.data import LocalCollection
+    from parsec_tpu.dsl.ptg import PTG, INOUT
+
+    nranks, n = 2, 8
+    fabric = InprocFabric(nranks)
+    ces = fabric.endpoints()
+    ctxs = [Context(nb_cores=2, rank=r, nranks=nranks, comm=ces[r])
+            for r in range(nranks)]
+    oks = [None] * nranks
+
+    def worker(r):
+        dc = LocalCollection("D", shape=(4,), nodes=nranks, myrank=r,
+                             init=lambda k: np.zeros(4))
+        dc.rank_of = lambda *key: dc.data_key(*key) % nranks
+        ptg = PTG("fcchain")
+        step = ptg.task_class("step", k=f"0 .. {n-1}")
+        step.affinity("D(k)")
+        step.flow("X", INOUT,
+                  "<- (k == 0) ? D(0) : X step(k-1)",
+                  f"-> (k < {n-1}) ? X step(k+1) : D(k)")
+        step.body(cpu=lambda X, k: X.__iadd__(1.0))
+        tp = ptg.taskpool(termdet="fourcounter", D=dc)
+        assert type(tp.tdm).__name__ == "TermDetFourCounter"
+        ctxs[r].add_taskpool(tp)
+        oks[r] = tp.wait(timeout=60)
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(nranks)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=90)
+    assert all(oks), oks
+    # the CE's single distributed-monitor slot was released at declare
+    assert getattr(ces[0], "_termdet_bound", None) is None
+    for c in ctxs:
+        c.fini()

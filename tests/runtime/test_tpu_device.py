@@ -254,3 +254,53 @@ def test_data_advise_preferred_device(ctx):
                    (d, INOUT))
     assert tp.wait(timeout=60)
     assert ran_on == ["tpu"]
+
+
+@pytest.mark.parametrize("eager", [1, 0])
+def test_eager_mixed_chore_ordering(eager):
+    """Round-1 VERDICT item 10a: under ``tpu_eager_complete`` a CPU
+    successor that MUTATES a tile is released at device-task dispatch —
+    while the device computation that reads the tile may still be in
+    flight.  Correct ordering falls out of the functional device design:
+    the device body read immutable input arrays (XLA semantics — there
+    is no tile memory a host write could race), the CPU successor's
+    stage_to_cpu blocks on the producing computation's OUTPUT array, and
+    its mutation lands in a fresh host buffer that becomes the next
+    version.  Reference polls real completion events instead
+    (device_gpu.c:1879-1999) because its bodies mutate device memory in
+    place.  Pinned under BOTH completion modes."""
+    from parsec_tpu.utils import mca_param
+
+    mca_param.set_param("device", "tpu_eager_complete", eager)
+    try:
+        ctx = Context(nb_cores=2)
+        try:
+            dev = tpu_dev(ctx)
+            d = data_create("t", payload=np.full((64, 64), 1.0, np.float32))
+            tp = DTDTaskpool(ctx)
+
+            def heavy_device(x):
+                # a long dependency chain keeps the computation in flight
+                # while the CPU successor is (eagerly) released
+                for _ in range(60):
+                    x = x @ jnp.eye(64, dtype=x.dtype) + 1.0
+                return x  # 1 + 60 = 61 everywhere
+
+            def cpu_mutate(x):
+                x += 1.0  # in-place on the staged host copy -> 62
+
+            def device_scale(x):
+                return x * 2.0  # -> 124
+
+            tp.insert_task({DEV_TPU: heavy_device}, (d, INOUT))
+            tp.insert_task({DEV_CPU: cpu_mutate}, (d, INOUT))
+            tp.insert_task({DEV_TPU: device_scale}, (d, INOUT))
+            assert tp.wait(timeout=120)
+            from parsec_tpu.dsl.dtd import stage_to_cpu
+
+            np.testing.assert_allclose(stage_to_cpu(d), 124.0)
+            assert dev.stats["executed_tasks"] == 2
+        finally:
+            ctx.fini()
+    finally:
+        mca_param.params.unset("device", "tpu_eager_complete")
