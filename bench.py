@@ -345,7 +345,11 @@ def panel_stage(n: int, nb: int, measure) -> dict:
 
     ctx = Context(nb_cores=int(os.environ.get("BENCH_CORES", "2")))
     try:
-        sc = SegmentedCholesky(ctx, n, nb, strip=4096)
+        # tail=8192: the trailing quarter's panels are enqueue-latency-
+        # bound (device time below per-program RPC latency through the
+        # tunnel), so they fuse into one program; the leading panels stay
+        # one task each — the runtime still schedules the DAG
+        sc = SegmentedCholesky(ctx, n, nb, strip=4096, tail=8192)
         t0 = time.perf_counter()
         err_r = float(gate(sc.run(copy(pristine))))
         t_first_r = time.perf_counter() - t0
@@ -367,9 +371,15 @@ def panel_stage(n: int, nb: int, measure) -> dict:
         ctx.fini()
     g_whole = flops / min(t_whole, t_whole2) / 1e9
     g_rt = flops / min(t_rt, t_rt2) / 1e9
+    # adaptive precision labeling: the HIGHEST-precision gate measures
+    # the FACTORIZATION's true error.  XLA's default TPU matmul path
+    # measures f32-class here (3.6e-7 observed) — fields then carry the
+    # plain name and the f32 1e-3 bar; if a backend/version ever lands
+    # in bf16-class territory the fields say so (_bf16, 1e-2 bar)
+    tag = "" if max(err_w, err_r) <= 1e-3 else "_bf16"
     return {
-        f"whole_chol_N{n}_nb{nb}_bf16_gflops": round(g_whole, 2),
-        f"runtime_chol_N{n}_nb{nb}_bf16_gflops": round(g_rt, 2),
+        f"whole_chol_N{n}_nb{nb}{tag}_gflops": round(g_whole, 2),
+        f"runtime_chol_N{n}_nb{nb}{tag}_gflops": round(g_rt, 2),
         "runtime_vs_whole": round(g_rt / g_whole, 3),
         "whole_chol_compile_s": round(t_first_w, 1),
         "runtime_chol_compile_s": round(t_first_r, 1),

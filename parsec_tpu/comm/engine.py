@@ -108,16 +108,27 @@ class CommEngine(Component):
     # -- distributed-termdet message accounting (the four counters):
     # every non-TERMDET message is counted at the CE boundary on both
     # sides, so a wave observing idle ranks with sent != recv knows a
-    # message is still in flight (reference termdet.h:153-232)
+    # message is still in flight (reference termdet.h:153-232).  The
+    # counters live on the CE and count from CONSTRUCTION — a message
+    # delivered before a rank's monitor binds (startup skew) must still
+    # be in the totals, or sent/recv never balances and termination is
+    # never concluded.  Cumulative totals are fine: balance at quiesce
+    # holds regardless of when counting started, as long as both sides
+    # counted every message.
+    termdet_sent: int = 0
+    termdet_recv: int = 0
+    #: send_am is called from arbitrary threads; += is not atomic
+    _termdet_lock = threading.Lock()
+
     def _termdet_note_sent(self, tag: int) -> None:
-        t = getattr(self, "_termdet_bound", None)
-        if t is not None and tag != 3:  # TAG_TERMDET
-            t.note_message_sent()
+        if tag != 3:  # TAG_TERMDET
+            with CommEngine._termdet_lock:
+                self.termdet_sent += 1
 
     def _termdet_note_recv(self, tag: int) -> None:
-        t = getattr(self, "_termdet_bound", None)
-        if t is not None and tag != 3:
-            t.note_message_recv()
+        if tag != 3:
+            with CommEngine._termdet_lock:
+                self.termdet_recv += 1
 
     # -- one-sided ------------------------------------------------------
     def mem_register(self, handle: Any, buffer: Any, once: bool = False,

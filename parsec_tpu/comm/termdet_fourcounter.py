@@ -144,7 +144,15 @@ class TermDetFourCounter(TermDetMonitor):
     def _local_state(self) -> Tuple[bool, int, int]:
         with self._lock:
             busy = (not self._ready) or self._nb_tasks != 0 or self._runtime_actions != 0
-            return busy, self.msgs_sent, self.msgs_recv
+            s, r = self.msgs_sent, self.msgs_recv
+        # production: the CE counts every app message from CONSTRUCTION
+        # (messages delivered before this monitor bound are included);
+        # the monitor-local counters serve protocol-level tests driving
+        # note_message_* by hand
+        if self.ce is not None:
+            s += self.ce.termdet_sent
+            r += self.ce.termdet_recv
+        return busy, s, r
 
     #: production wave pacing: idle_progress initiates at most one wave
     #: per interval (seconds) — waves are the idle-time FALLBACK; the

@@ -188,6 +188,25 @@ class Data:
         return f"Data(key={self.key}, copies={list(self.copies)})"
 
 
+def land_into_home(home: "Data", payload) -> None:
+    """Receiver half of a cross-rank final write-back: store the arrived
+    value into the home tile's host copy and bump its version.  Shared by
+    every consumer of the writeback wire message (PTG taskpools,
+    the distributed native executor) — both sides of the protocol must
+    land payloads identically."""
+    if payload is None:
+        return
+    import numpy as np
+
+    dst = home.get_copy(0)
+    buf = np.asarray(payload)
+    if dst is None or dst.payload is None:
+        home.attach_copy(0, np.array(buf))  # writable private copy
+    else:
+        np.copyto(dst.payload, buf)
+    home.version_bump(0)
+
+
 def data_create(key: Any, collection=None, payload=None, device_index: int = 0, **kw) -> Data:
     """Reference ``parsec_data_create``: make a Data with an initial
     device-0 (CPU) copy."""

@@ -407,6 +407,15 @@ class TpuDevice(Device):
     def _evict_one(self) -> bool:
         if self._lru_clean:
             _, victim = self._lru_clean.popitem(last=False)
+            mine = victim.get_copy(self.data_index)
+            host = victim.get_copy(0)
+            if mine is not None and (host is None or host.payload is None
+                                     or host.version < mine.version):
+                # a CLEAN device copy can still be the ONLY valid copy:
+                # device-native arrivals (_deposit_payload, bytes_d2d)
+                # attach no host copy — dropping without write-back would
+                # destroy the data
+                self._writeback(victim)
             self._drop_copy(victim)
             return True
         if self._lru_dirty:

@@ -132,7 +132,16 @@ def stage_to_cpu(data: Data) -> np.ndarray:
     if newest is None:
         raise RuntimeError(f"{data!r} has no valid copy")
     if newest.device_index == 0:
-        return newest.payload
+        if isinstance(newest.payload, np.ndarray):
+            return newest.payload
+        # a device-capable fabric can deposit a jax.Array at the host
+        # slot (remote_dep flow payload, ptg._deposit_payload): CPU
+        # bodies mutate in place, so normalize to a writable ndarray
+        host = np.asarray(newest.payload)
+        if not host.flags.writeable:
+            host = host.copy()
+        newest.payload = host
+        return host
     host = np.asarray(newest.payload)
     if not host.flags.writeable:
         host = host.copy()  # D2H of a jax.Array is a read-only view

@@ -249,14 +249,10 @@ class NativeDistExecutor(NativeExecutor):
 
     def incoming_writeback(self, cname: str, key: Tuple, payload) -> None:
         if payload is not None:
-            home = self.taskpool.constants[cname].data_of(*key)
-            dst = home.get_copy(0)
-            buf = np.asarray(payload)
-            if dst is None or dst.payload is None:
-                home.attach_copy(0, np.array(buf))
-            else:
-                np.copyto(dst.payload, buf)
-            home.version_bump(0)
+            from ..data.data import land_into_home
+
+            land_into_home(self.taskpool.constants[cname].data_of(*key),
+                           payload)
         with self._net_lock:
             phl = self._wb_phantoms.get((cname, tuple(key)))
             ph = phl.pop() if phl else None

@@ -68,6 +68,14 @@ class NativeExecutor:
 
     # -- tile resolution (same rules as ptg_to_dtd / xla_lower) ----------
     def _payload(self, srckey: Tuple) -> np.ndarray:
+        if srckey[0] == "remote":
+            # a flow chain that leaves the captured partition: this
+            # single-rank executor cannot resolve it (silently handing
+            # back a zeros tile would corrupt numerics) — distributed
+            # captures go through dsl.native_dist.NativeDistExecutor
+            raise RuntimeError(
+                f"flow source {srckey[1]}/{srckey[2]} is on another rank; "
+                "use NativeDistExecutor for rank-filtered captures")
         consts = self.taskpool.constants
         if srckey[0] == "data":
             _, cname, key = srckey

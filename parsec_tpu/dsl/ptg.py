@@ -1011,14 +1011,9 @@ class PTGTaskpool(Taskpool):
         pure retire: the producer's flow resolved to no data, but the
         arrival was pre-counted so it must still drain the counter."""
         if payload is not None:
-            home = self.constants[cname].data_of(*key)
-            dst = home.get_copy(0)
-            buf = np.asarray(payload)
-            if dst is None or dst.payload is None:
-                home.attach_copy(0, np.array(buf))
-            else:
-                np.copyto(dst.payload, buf)
-            home.version_bump(0)
+            from ..data.data import land_into_home
+
+            land_into_home(self.constants[cname].data_of(*key), payload)
         self.tdm.taskpool_addto_runtime_actions(self, -1)
 
     def _count_expected_writebacks(self, rank: int) -> int:
@@ -1115,27 +1110,15 @@ class PTGTaskpool(Taskpool):
             self.context.schedule(ready, es=self.context.current_es())
 
     def _deposit_payload(self, key, payload):
-        """Land an arrived flow payload.  Device-resident arrivals (a
-        device-capable fabric shipped a ``jax.Array``) go STRAIGHT onto
-        this rank's chip — a device_put from the producer's device is a
-        direct device-to-device transfer (ICI-class on multi-chip; no
-        host numpy anywhere, SURVEY §5.8).  Host arrivals attach as the
-        CPU copy exactly as before."""
-        from ..comm.payload import is_device_array
-
-        if is_device_array(payload) and self.context is not None:
-            dev = next((d for d in self.context.devices
-                        if d.mca_name == "tpu"), None)
-            if dev is not None:
-                import jax
-
-                arr = jax.device_put(payload, dev.jdev)
-                d = data_create(key)
-                c = d.attach_copy(dev.data_index, arr)
-                c.version = 1  # the only copy: newest by construction
-                d.shape, d.dtype = arr.shape, arr.dtype
-                dev.stats["bytes_d2d"] += payload.nbytes
-                return d
+        """Land an arrived flow payload.  A device-resident arrival (a
+        device-capable fabric shipped a ``jax.Array``) is attached AS-IS:
+        a device consumer's stage-in turns it into a direct
+        device-to-device ``device_put`` (ICI-class on multi-chip, no host
+        numpy — SURVEY §5.8) INSIDE the device manager, where HBM
+        accounting and LRU mutation are single-threaded; a CPU consumer's
+        ``stage_to_cpu`` normalizes it to a writable host array lazily.
+        Landing it eagerly here would mutate residency state from the
+        comm thread and bypass the budget."""
         return data_create(key, payload=payload)
 
 

@@ -49,6 +49,12 @@ def replay_via_dtd(
     ctl_tiles: Dict[Tuple, Data] = {}   # producer tid -> dummy control tile
 
     def tile_for(srckey: Tuple) -> Data:
+        if srckey[0] == "remote":
+            # chain leaves the captured partition: a zeros stand-in would
+            # silently corrupt numerics — this replay is single-partition
+            raise RuntimeError(
+                f"flow source {srckey[1]}/{srckey[2]} is on another rank; "
+                "ptg_to_dtd replays one rank's full capture only")
         if srckey[0] == "data":
             _, cname, key = srckey
             return consts[cname].data_of(*key)
