@@ -24,6 +24,7 @@ ALL = [
     "ex10_sequence_parallel.py",
     "ex11_pallas_native.py",
     "ex12_qr_lu.py",
+    "ex13_segmented_native_dist.py",
     os.path.join("dtd", "dtd_helloworld.py"),
     os.path.join("dtd", "dtd_hello_arg.py"),
     os.path.join("dtd", "dtd_untied.py"),
