@@ -445,7 +445,7 @@ def qrlu_stage(n: int, nb: int, measure) -> dict:
         c_q = time.perf_counter() - t0
         if not np.isfinite(err_q) or err_q > 1e-3:
             raise RuntimeError(f"segmented QR numerics off ({err_q})")
-        sl = SegmentedLU(ctx, n, nb)
+        sl = SegmentedLU(ctx, n, nb, tail=8192)
         t0 = time.perf_counter()
         err_l = float(gate_lu(sl.run(copy(A_lu))))
         c_l = time.perf_counter() - t0
