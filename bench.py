@@ -367,36 +367,52 @@ def panel_stage(n: int, nb: int, measure) -> dict:
         t_rt = measure(lambda: sc.run(copy(pristine)), 2) - t_copy
         t_whole2 = measure(lambda: wc.run(copy(pristine)), 2) - t_copy
         t_rt2 = measure(lambda: sc.run(copy(pristine)), 2) - t_copy
-        # bf16 leg: operands cast to bf16, f32 accumulate/storage — the
-        # tile-level mixed-precision mode, ~2x the default path.  Fields
-        # carry the _bf16 suffix UNCONDITIONALLY: the KMS gate input's
-        # entries are powers of two (exact in bf16), so the measured err
-        # here cannot distinguish precision classes — generic-input bf16
-        # error is ~1e-4..1e-3 class (round-1 measurements)
+        to_f32 = jax.jit(lambda x: x.astype(jnp.float32))
+
+        def precision_leg(variant, suffix, feed, extra):
+            """Gate + min-of-2 interleaved measurement of one mixed-
+            precision (whole, runtime) pair; returns suffixed fields or
+            {} if the 1e-2 bf16-class gate fails (degrade, don't fail)."""
+            wcv = WholeCholesky(n, nb, strip=4096, bf16=variant)
+            err_w2 = float(gate(to_f32(wcv.run(copy(feed)))))
+            scv = SegmentedCholesky(ctx, n, nb, strip=4096, tail=8192,
+                                    bf16=variant)
+            err_r2 = float(gate(to_f32(scv.run(copy(feed)))))
+            if not (np.isfinite(err_w2) and err_w2 <= 1e-2
+                    and np.isfinite(err_r2) and err_r2 <= 1e-2):
+                print(f"{suffix} panel leg dropped (err {err_w2}/{err_r2})",
+                      file=sys.stderr)
+                return {}
+            t_c = measure(lambda: copy(feed), 2)
+            t_w = measure(lambda: wcv.run(copy(feed)), 2) - t_c
+            t_r = measure(lambda: scv.run(copy(feed)), 2) - t_c
+            t_w = min(t_w, measure(lambda: wcv.run(copy(feed)), 2) - t_c)
+            t_r = min(t_r, measure(lambda: scv.run(copy(feed)), 2) - t_c)
+            return {
+                f"whole_chol_N{n}_nb{nb}_{suffix}_gflops":
+                    round(flops / t_w / 1e9, 2),
+                f"runtime_chol_N{n}_nb{nb}_{suffix}_gflops":
+                    round(flops / t_r / 1e9, 2),
+                **extra(max(err_w2, err_r2)),
+            }
+
+        # bf16 operand leg (~2x MXU): fields carry the _bf16 suffix
+        # UNCONDITIONALLY — the KMS gate input's entries are powers of
+        # two (exact in bf16) so the measured err cannot distinguish
+        # precision classes; generic-input bf16 error is 1e-4..1e-3 class
         bf16_fields = {}
         if os.environ.get("BENCH_PANEL_BF16", "1") != "0":
-            wcb = WholeCholesky(n, nb, strip=4096, bf16=True)
-            err_wb = float(gate(wcb.run(copy(pristine))))
-            scb = SegmentedCholesky(ctx, n, nb, strip=4096, tail=8192,
-                                    bf16=True)
-            err_rb = float(gate(scb.run(copy(pristine))))
-            if np.isfinite(err_wb) and err_wb <= 1e-2 \
-                    and np.isfinite(err_rb) and err_rb <= 1e-2:
-                t_wb = measure(lambda: wcb.run(copy(pristine)), 2) - t_copy
-                t_rb = measure(lambda: scb.run(copy(pristine)), 2) - t_copy
-                t_wb = min(t_wb,
-                           measure(lambda: wcb.run(copy(pristine)), 2) - t_copy)
-                t_rb = min(t_rb,
-                           measure(lambda: scb.run(copy(pristine)), 2) - t_copy)
-                bf16_fields = {
-                    f"whole_chol_N{n}_nb{nb}_bf16_gflops":
-                        round(flops / t_wb / 1e9, 2),
-                    f"runtime_chol_N{n}_nb{nb}_bf16_gflops":
-                        round(flops / t_rb / 1e9, 2),
-                }
-            else:  # pragma: no cover - degrade, don't fail
-                print(f"bf16 panel leg dropped (err {err_wb}/{err_rb})",
-                      file=sys.stderr)
+            bf16_fields.update(precision_leg(True, "bf16", pristine,
+                                             lambda e: {}))
+        # bf16 STORAGE leg: the matrix itself lives in bf16 — HALF the
+        # HBM traffic, the binding constraint at north-star sizes (f32
+        # storage at N=32768 is bandwidth-bound: identical times at any
+        # compute precision)
+        if os.environ.get("BENCH_PANEL_STOREBF16", "1") != "0":
+            pristine_b = jax.jit(lambda x: x.astype(jnp.bfloat16))(pristine)
+            bf16_fields.update(precision_leg(
+                "storage", "bf16storage", pristine_b,
+                lambda e: {"bf16storage_err": float(f"{e:.2e}")}))
     finally:
         ctx.fini()
     g_whole = flops / min(t_whole, t_whole2) / 1e9

@@ -112,6 +112,12 @@ class PanelCholesky:
                  bf16: bool = False, strip: int = 0, device=None):
         if n % nb:
             raise ValueError(f"N={n} not divisible by nb={nb}")
+        if bf16 == "storage":
+            raise ValueError(
+                "PanelCholesky does not implement bf16='storage' — use "
+                "WholeCholesky or SegmentedCholesky for the bf16-storage "
+                "mode (a truthy string would silently run the operand-"
+                "cast mode at full-f32 HBM traffic)")
         self.n, self.nb, self.bucket, self.bf16 = n, nb, bucket, bf16
         self.nt = n // nb
         # pad so every bucketed trailing slice stays in bounds
@@ -178,41 +184,60 @@ class WholeCholesky:
     ``strip`` bounds the trailing-update temporaries (R x strip); the
     strips are unrolled statically, adding ~N/strip ops per step."""
 
-    def __init__(self, n: int, nb: int = 512, *, bf16: bool = False,
+    def __init__(self, n: int, nb: int = 512, *, bf16=False,
                  strip: int = 4096):
         if n % nb:
             raise ValueError(f"N={n} not divisible by nb={nb}")
         if strip % nb:
             raise ValueError(f"strip {strip} must be a multiple of nb {nb}")
+        #: ``bf16``: False = storage precision; True = bf16 operand casts
+        #: (f32 accumulate/storage); "storage" = the matrix lives in
+        #: bf16 — HALF the HBM traffic, the binding constraint at
+        #: north-star sizes (bf16-class numerics)
         self.n, self.nb, self.bf16, self.strip = n, nb, bf16, strip
+        self.store_bf16 = bf16 == "storage"
         self.nt = n // nb
         self._fn = jax.jit(self._factorize, donate_argnums=(0,))
 
     def _factorize(self, A):
         n, nb, bf16, strip = self.n, self.nb, self.bf16, self.strip
-        f32 = A.dtype
+        store = self.store_bf16
+        f32 = jnp.float32 if store else A.dtype
         for k in range(self.nt):
             k0 = k * nb
-            D = A[k0:k0 + nb, k0:k0 + nb]
+            D = A[k0:k0 + nb, k0:k0 + nb].astype(f32)
             L = jnp.linalg.cholesky(D)
             W = lax.linalg.triangular_solve(
                 L, jnp.eye(nb, dtype=f32), lower=True, left_side=True)
-            A = A.at[k0:k0 + nb, k0:k0 + nb].set(jnp.tril(L))
+            A = A.at[k0:k0 + nb, k0:k0 + nb].set(jnp.tril(L).astype(A.dtype))
             R = n - k0 - nb
             if R == 0:
                 continue
             P = A[k0 + nb:, k0:k0 + nb]
-            if bf16:
+            if store:
+                Pn = jnp.matmul(P.astype(f32), W.T,
+                                precision=lax.Precision.HIGHEST)
+                Pl = Pn.astype(jnp.bfloat16)
+                A = A.at[k0 + nb:, k0:k0 + nb].set(Pl)
+            elif bf16:
                 Pn = jnp.matmul(P.astype(jnp.bfloat16),
                                 W.T.astype(jnp.bfloat16),
                                 preferred_element_type=f32)
+                A = A.at[k0 + nb:, k0:k0 + nb].set(Pn)
+                Pl = Pn.astype(jnp.bfloat16)
             else:
                 Pn = P @ W.T
-            A = A.at[k0 + nb:, k0:k0 + nb].set(Pn)
-            Pl = Pn.astype(jnp.bfloat16) if bf16 else Pn
+                A = A.at[k0 + nb:, k0:k0 + nb].set(Pn)
+                Pl = Pn
             for c0 in range(k0 + nb, n, strip):
                 w = min(strip, n - c0)
                 Pj = Pl[c0 - (k0 + nb):c0 - (k0 + nb) + w, :]
+                if store:
+                    upd = jnp.matmul(Pl, Pj.T, preferred_element_type=f32)
+                    A = A.at[k0 + nb:, c0:c0 + w].set(
+                        (A[k0 + nb:, c0:c0 + w].astype(f32) - upd
+                         ).astype(jnp.bfloat16))
+                    continue
                 if bf16:
                     upd = jnp.matmul(Pl, Pj.T, preferred_element_type=f32)
                 else:
@@ -221,9 +246,18 @@ class WholeCholesky:
         return A
 
     def run(self, A):
-        """Factorize a device matrix (n x n) in place; donated."""
+        """Factorize a device matrix (n x n) in place; donated.  In
+        storage mode the input must arrive (or is cast) bf16 — an f32
+        matrix would silently keep full-f32 HBM traffic with
+        bf16-rounded numerics, the worst of both modes."""
+        if self.store_bf16 and A.dtype != jnp.bfloat16:
+            A = A.astype(jnp.bfloat16)
         return self._fn(A)
 
     def __call__(self, A_np: np.ndarray) -> np.ndarray:
-        A = self._fn(jnp.asarray(np.ascontiguousarray(A_np)))
-        return np.tril(np.asarray(A))
+        A = jnp.asarray(np.ascontiguousarray(A_np))
+        if self.store_bf16:
+            A = A.astype(jnp.bfloat16)
+        out = np.asarray(self.run(A), dtype=np.float32) \
+            if self.store_bf16 else np.asarray(self.run(A))
+        return np.tril(out)

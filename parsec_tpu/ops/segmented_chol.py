@@ -80,32 +80,52 @@ def _make_panel_body(n: int, nb: int, bf16: bool, strip: int, kt: int):
     the reference makes with recursive tasks on small trailing blocks
     (``/root/reference/parsec/recursive.h``)."""
 
+    store_bf16 = bf16 == "storage"
+
     def step(M, k):
         k0 = k * nb
-        f32 = M.dtype
-        D = M[k0:k0 + nb, k0:k0 + nb]
+        f32 = jnp.float32 if store_bf16 else M.dtype
+        D = M[k0:k0 + nb, k0:k0 + nb].astype(f32)
         L = jnp.linalg.cholesky(D)
         # trsm-as-matmul: invert the nb x nb factor once (off the MXU)
         # and turn the panel solve into one MXU gemm (BASELINE.md)
         W = lax.linalg.triangular_solve(
             L, jnp.eye(nb, dtype=f32), lower=True, left_side=True)
-        M = M.at[k0:k0 + nb, k0:k0 + nb].set(jnp.tril(L))
+        M = M.at[k0:k0 + nb, k0:k0 + nb].set(jnp.tril(L).astype(M.dtype))
         R = n - k0 - nb
         if R == 0:
             return M
         P = M[k0 + nb:, k0:k0 + nb]
-        if bf16:
+        if store_bf16:
+            # panel solve in f32 (HIGHEST: 6-pass products), factor
+            # stored back in bf16 — storage precision IS the mode
+            Pn = jnp.matmul(P.astype(f32), W.T,
+                            precision=lax.Precision.HIGHEST)
+            Pl = Pn.astype(jnp.bfloat16)
+            M = M.at[k0 + nb:, k0:k0 + nb].set(Pl)
+        elif bf16:
             Pn = jnp.matmul(P.astype(jnp.bfloat16), W.T.astype(jnp.bfloat16),
                             preferred_element_type=f32)
+            M = M.at[k0 + nb:, k0:k0 + nb].set(Pn)
+            Pl = Pn.astype(jnp.bfloat16)
         else:
             Pn = P @ W.T
-        M = M.at[k0 + nb:, k0:k0 + nb].set(Pn)
-        Pl = Pn.astype(jnp.bfloat16) if bf16 else Pn
+            M = M.at[k0 + nb:, k0:k0 + nb].set(Pn)
+            Pl = Pn
         # strip-mined symmetric update: bounds per-step temporaries to
         # R x strip so async-enqueued steps coexist in HBM
         for c0 in range(k0 + nb, n, strip):
             w = min(strip, n - c0)
             Pj = Pl[c0 - (k0 + nb):c0 - (k0 + nb) + w, :]
+            if store_bf16:
+                # f32-accumulated MXU product; the trailing matrix itself
+                # lives in bf16 — HALF the HBM traffic of f32 storage
+                # (the bound at north-star sizes)
+                upd = jnp.matmul(Pl, Pj.T, preferred_element_type=f32)
+                M = M.at[k0 + nb:, c0:c0 + w].set(
+                    (M[k0 + nb:, c0:c0 + w].astype(f32) - upd
+                     ).astype(jnp.bfloat16))
+                continue
             if bf16:
                 upd = jnp.matmul(Pl, Pj.T, preferred_element_type=f32)
             else:
@@ -123,17 +143,24 @@ def _make_panel_body(n: int, nb: int, bf16: bool, strip: int, kt: int):
 
     panel._static_values = True
     panel._donate_args = (0,)  # the matrix updates in place on device
-    panel._jit_key = ("segchol_panel", n, nb, bf16, strip, kt)
+    panel._jit_key = ("segchol_panel", n, nb, str(bf16), strip, kt)
     return panel
 
 
-def segmented_cholesky_ptg(n: int, nb: int, *, bf16: bool = False,
+def segmented_cholesky_ptg(n: int, nb: int, *, bf16=False,
                            strip: int = 4096, tail: int = 4096) -> PTG:
     """Build the panel-segmented dpotrf PTG.  Instantiate with
     ``.taskpool(NT=KT+1, A=collection)`` — use :func:`n_segments` — where
     ``A(0)`` holds the full n x n SPD matrix; the factorization happens
     in place (lower).  ``tail`` fuses the final panels (trailing size
-    <= tail) into the last task; 0 disables fusing."""
+    <= tail) into the last task; 0 disables fusing.
+
+    ``bf16``: False = storage dtype precision; True = bf16 OPERAND casts
+    with f32 accumulate/storage; ``"storage"`` = the matrix itself lives
+    in bf16 (panel math upcast to f32) — HALF the HBM traffic, which is
+    the binding constraint at north-star sizes (N=32768 measures
+    bandwidth-bound in f32 storage: identical times at any compute
+    precision).  bf16-class numerics (~1e-3 relative on generic SPD)."""
     if n % nb:
         raise ValueError(f"N={n} not divisible by nb={nb}")
     strip = min(strip, n)
@@ -167,10 +194,11 @@ class SegmentedCholesky:
     includes attach/enumeration/dispatch); the matrix stays device-resident
     across steps via the device module's stage-in/epilog path."""
 
-    def __init__(self, context, n: int, nb: int, *, bf16: bool = False,
+    def __init__(self, context, n: int, nb: int, *, bf16=False,
                  strip: int = 4096, tail: int = 4096):
         self.context = context
         self.n, self.nb = n, nb
+        self.store_bf16 = bf16 == "storage"
         self.nt_tasks = n_segments(n, nb, tail)
         self.ptg = segmented_cholesky_ptg(n, nb, bf16=bf16, strip=strip,
                                           tail=tail)
@@ -181,7 +209,11 @@ class SegmentedCholesky:
 
     def run(self, A_dev, *, timeout: Optional[float] = 600):
         """Factorize a device-resident (n, n) array through the runtime.
-        ``A_dev`` is donated step-by-step; returns the device result."""
+        ``A_dev`` is donated step-by-step; returns the device result.
+        In storage mode the input must arrive (or is cast) bf16 — f32
+        input would keep full-f32 traffic with bf16 numerics."""
+        if self.store_bf16 and A_dev.dtype != jnp.bfloat16:
+            A_dev = A_dev.astype(jnp.bfloat16)
         d = _attach_device_matrix(self.device, "A", A_dev)
         tp = self.ptg.taskpool(NT=self.nt_tasks, A=d.collection)
         self.context.add_taskpool(tp)
@@ -198,6 +230,9 @@ class SegmentedCholesky:
         return payload
 
     def __call__(self, A_np: np.ndarray) -> np.ndarray:
-        A = jax.device_put(jnp.asarray(np.ascontiguousarray(A_np)),
-                           self.device.jdev)
-        return np.tril(np.asarray(jax.device_get(self.run(A))))
+        A = jnp.asarray(np.ascontiguousarray(A_np))
+        if self.store_bf16:
+            A = A.astype(jnp.bfloat16)
+        A = jax.device_put(A, self.device.jdev)
+        out = np.asarray(jax.device_get(self.run(A)), dtype=np.float32)
+        return np.tril(out)

@@ -117,3 +117,17 @@ def test_static_values_rejects_interleaved_args(ctx):
     t.selected_chore = FakeChore()
     with pytest.raises(RuntimeError, match="must.*trail|trail all data"):
         dev._submit(t)
+
+
+def test_segmented_store_bf16_matches_numpy(ctx):
+    """bf16-STORAGE mode: the matrix lives in bf16 (half the HBM traffic
+    — the binding constraint at north-star sizes); panel math upcast to
+    f32.  bf16-class numerics on a generic SPD input."""
+    n, nb = 256, 64
+    SPD = _spd(n)
+    sc = SegmentedCholesky(ctx, n, nb, strip=128, tail=0, bf16="storage")
+    L = sc(SPD)
+    assert L.dtype == np.float32  # __call__ upcasts the bf16 result
+    ref = np.linalg.cholesky(SPD.astype(np.float64))
+    rel = np.max(np.abs(L - ref)) / np.max(np.abs(ref))
+    assert rel < 5e-2, rel  # bf16-class (eps ~8e-3, growth over panels)
