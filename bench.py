@@ -30,6 +30,21 @@ import time
 import numpy as np
 
 
+_T_START = time.perf_counter()
+#: wall-clock budget (seconds): optional stages shed themselves as the
+#: budget fills, because the ONE JSON line only prints at the end — a
+#: driver-side timeout mid-stage would lose EVERYTHING measured so far
+_BUDGET = float(os.environ.get("BENCH_TIME_BUDGET", "5400"))
+
+
+def _over_budget(frac: float, what: str) -> bool:
+    if time.perf_counter() - _T_START > frac * _BUDGET:
+        print(f"{what} skipped: over {frac:.0%} of the "
+              f"{_BUDGET:.0f}s time budget", file=sys.stderr)
+        return True
+    return False
+
+
 def main() -> None:
     import jax
 
@@ -243,7 +258,8 @@ def main() -> None:
             print(f"panel stage skipped: {e}", file=sys.stderr)
 
     # ---- QR / LU through the runtime (segmented, f32-class, 1e-3 gate) -
-    if on_accel and os.environ.get("BENCH_QRLU", "1") != "0":
+    if on_accel and os.environ.get("BENCH_QRLU", "1") != "0" \
+            and not _over_budget(0.75, "qr/lu stage"):
         try:
             panel_fields.update(qrlu_stage(
                 int(os.environ.get("BENCH_QRLU_N", "8192")),
@@ -401,14 +417,16 @@ def panel_stage(n: int, nb: int, measure) -> dict:
         # two (exact in bf16) so the measured err cannot distinguish
         # precision classes; generic-input bf16 error is 1e-4..1e-3 class
         bf16_fields = {}
-        if os.environ.get("BENCH_PANEL_BF16", "1") != "0":
+        if os.environ.get("BENCH_PANEL_BF16", "1") != "0" \
+                and not _over_budget(0.55, "bf16 panel leg"):
             bf16_fields.update(precision_leg(True, "bf16", pristine,
                                              lambda e: {}))
         # bf16 STORAGE leg: the matrix itself lives in bf16 — HALF the
         # HBM traffic, the binding constraint at north-star sizes (f32
         # storage at N=32768 is bandwidth-bound: identical times at any
         # compute precision)
-        if os.environ.get("BENCH_PANEL_STOREBF16", "1") != "0":
+        if os.environ.get("BENCH_PANEL_STOREBF16", "1") != "0" \
+                and not _over_budget(0.65, "bf16-storage leg"):
             pristine_b = jax.jit(lambda x: x.astype(jnp.bfloat16))(pristine)
             bf16_fields.update(precision_leg(
                 "storage", "bf16storage", pristine_b,
