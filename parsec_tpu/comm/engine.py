@@ -121,12 +121,12 @@ class CommEngine(Component):
     _termdet_lock = threading.Lock()
 
     def _termdet_note_sent(self, tag: int) -> None:
-        if tag != 3:  # TAG_TERMDET
+        if tag != TAG_TERMDET:  # waves must not count as app traffic
             with CommEngine._termdet_lock:
                 self.termdet_sent += 1
 
     def _termdet_note_recv(self, tag: int) -> None:
-        if tag != 3:
+        if tag != TAG_TERMDET:
             with CommEngine._termdet_lock:
                 self.termdet_recv += 1
 

@@ -278,12 +278,22 @@ class RemoteDepManager:
             #  * pool not yet seen     -> park: this rank is still
             #    attaching (startup skew) and must fail at registration,
             #    not discover the loss by exhausting its wait() timeout.
+            # completed-check AND the lookup/park decision under ONE lock
+            # acquisition: taskpool_done racing between them would park a
+            # stale abort that replays into the next pool reusing the name
             with self._lock:
                 if msg["pool"] in self._completed:
                     debug.verbose(3, "comm", "abort for finished pool %s "
                                   "from rank %d: dropped", msg["pool"],
                                   src_rank)
                     return
+                tp = self._taskpools.get(msg["pool"])
+                if tp is None:
+                    self._noobj[msg["pool"]].append((src_rank, msg))
+                    self.stats["parked"] += 1
+                    return
+            self._deliver(tp, src_rank, msg)
+            return
         tp = self._lookup_or_park(src_rank, msg, self._noobj, "parked")
         if tp is not None:
             self._deliver(tp, src_rank, msg)

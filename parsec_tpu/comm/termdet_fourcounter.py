@@ -90,14 +90,23 @@ class TermDetFourCounter(TermDetMonitor):
     # -- piggyback channel ------------------------------------------------
     def _pb_state(self):
         """Stamped on every outgoing application frame (tiny, monotonic
-        seq disambiguates reordered frames)."""
+        seq disambiguates reordered frames).  Must account the SAME
+        quantities as :meth:`_local_state` (monitor-local counters plus
+        the CE's app-message counters) or rank 0 would compare
+        piggybacked peer states against incommensurable wave totals and
+        the balanced-picture check could never pass."""
         with self._lock:
             if self._terminated:
                 return None
             self._pb_seq += 1
             busy = (not self._ready) or self._nb_tasks != 0 \
                 or self._runtime_actions != 0
-            return (self._pb_seq, busy, self.msgs_sent, self.msgs_recv)
+            s, r = self.msgs_sent, self.msgs_recv
+        if self.ce is not None:
+            # plain-int reads; same sourcing as _local_state
+            s += self.ce.termdet_sent
+            r += self.ce.termdet_recv
+        return (self._pb_seq, busy, s, r)
 
     def _pb_recv(self, src: int, state) -> None:
         if not isinstance(state, tuple) or len(state) != 4:

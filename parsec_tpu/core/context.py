@@ -147,6 +147,52 @@ class Context:
         """Reference ``parsec_context_add_taskpool`` (scheduling.c:832):
         register, notify comm layer, run the startup hook, enqueue the
         initially-ready tasks."""
+        # Distributed termdet monitors (fourcounter) bind to the comm
+        # engine and are driven from the idle loop (_progress_comm); one
+        # distributed monitor per CE at a time — the TERMDET tag and
+        # piggyback channel are single-slot.  The slot decision happens
+        # FIRST, before the pool is registered anywhere: a refusal must
+        # not leave a zombie half-registration, and a tdm swap must
+        # happen before attached() counts into it or the comm layer can
+        # deliver for it (no lost updates).
+        if self.comm is not None:
+            tdm = tp.tdm
+            if hasattr(tdm, "bind") and getattr(tdm, "ce", None) is None:
+                with self._cv:  # atomic slot claim across adder threads
+                    claimed = getattr(self.comm, "_termdet_bound",
+                                      None) is None
+                    if claimed:
+                        self.comm._termdet_bound = tdm
+                if claimed:
+                    tdm.bind(self.comm)
+                elif getattr(tp, "auto_count", False):
+                    # an UNBOUND fourcounter monitor has no wave driver
+                    # and can never declare termination, and dynamic
+                    # discovery (DTD) NEEDS the four-counter protocol to
+                    # see in-flight remote activations — refuse loudly
+                    # rather than risk premature quiescence or a wait()
+                    # that always runs to its timeout
+                    raise RuntimeError(
+                        f"taskpool {tp.name}: comm engine already "
+                        "carries a distributed termdet monitor and "
+                        "this pool's task count is dynamically "
+                        "discovered — one fourcounter pool at a time "
+                        "(wait for the bound pool to finish first)")
+                else:
+                    # front-ends that manage their own accounting (PTG:
+                    # pre-counted local tasks + write-back runtime
+                    # actions, auto_count=False) are correct under local
+                    # termdet — that IS the default distributed path
+                    from .termdet import TermDetLocal
+
+                    debug.warning(
+                        "taskpool %s: comm engine already carries a "
+                        "distributed termdet monitor; falling back to "
+                        "local termdet (one fourcounter pool at a time)",
+                        tp.name)
+                    fresh = TermDetLocal()
+                    fresh.monitor_taskpool(tp, tp._termination_detected)
+                    tp.tdm = fresh
         with self._cv:
             self._taskpools[tp.taskpool_id] = tp
             self._active_taskpools += 1
@@ -155,22 +201,6 @@ class Context:
             tp.on_enqueue(tp)
         if self.comm is not None:
             self.comm.new_taskpool(tp)
-            # distributed termdet monitors (fourcounter) bind to the comm
-            # engine here and are driven from the idle loop
-            # (_progress_comm); one distributed monitor per CE at a time
-            # — the TERMDET tag and piggyback channel are single-slot
-            tdm = tp.tdm
-            if hasattr(tdm, "bind") and getattr(tdm, "ce", None) is None:
-                bound = getattr(self.comm, "_termdet_bound", None)
-                if bound is None:
-                    tdm.bind(self.comm)
-                    self.comm._termdet_bound = tdm
-                else:
-                    debug.warning(
-                        "taskpool %s: comm engine already carries a "
-                        "distributed termdet monitor; %s falls back to "
-                        "unbound (one fourcounter pool at a time)",
-                        tp.name, type(tdm).__name__)
         # hold a runtime action across ready+startup so an empty-looking pool
         # cannot declare termination before its startup tasks are accounted
         tp.tdm.taskpool_addto_runtime_actions(tp, 1)

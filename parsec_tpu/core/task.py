@@ -141,6 +141,8 @@ class Task:
         "prof",
         "user",
         "_tpu_completed",
+        "_tpu_attempts",
+        "_tpu_effects",
     )
 
     def __init__(

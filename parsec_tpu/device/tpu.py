@@ -214,15 +214,15 @@ class TpuDevice(Device):
         return HookReturn.ASYNC  # completions were issued by the manager
 
     def _manager_loop(self, es) -> None:
-        from ..core import scheduling
-
+        # phase: check_in_deps + exec — submit everything pending
         while True:
-            # phase: check_in_deps + exec — submit everything pending
             while True:
                 with self._lock:
                     task = self._pending.popleft() if self._pending else None
                 if task is None:
                     break
+                if getattr(task.taskpool, "failed", False):
+                    continue  # pool already failed: discard, never execute
                 try:
                     self._submit(task, es)
                 except Exception as e:
@@ -231,10 +231,38 @@ class TpuDevice(Device):
 
                     traceback.print_exc()
                     # eager _submit may have begun releasing successors
-                    # before raising — completing again would double-release
-                    # dependency counters
-                    if not getattr(task, "_tpu_completed", False):
-                        scheduling.complete_execution(self.context, es, task)
+                    # before raising — retrying or completing again would
+                    # double-release dependency counters: fail the pool
+                    if getattr(task, "_tpu_completed", False):
+                        self._fail_task_pool(
+                            task, f"device epilog/completion raised: {e!r}")
+                        continue
+                    # one retry with fresh state: a transient PJRT/tunnel
+                    # RPC error must not zero a run (_submit re-stages
+                    # inputs from the newest valid copies, so the retry
+                    # starts clean).  ONLY when the first attempt provably
+                    # had no side effects — a partially-committed epilog
+                    # (some output tiles rebound + version-bumped) or a
+                    # donated input buffer would make the retry
+                    # double-apply INOUT updates: silent corruption, the
+                    # exact mode this path exists to eliminate.
+                    attempts = getattr(task, "_tpu_attempts", 0) + 1
+                    task._tpu_attempts = attempts
+                    if attempts == 1 and not getattr(task, "_tpu_effects",
+                                                     False):
+                        debug.warning("retrying device submit of %r", task)
+                        with self._lock:
+                            self._pending.append(task)
+                        continue
+                    # retry failed too: completing the task anyway would
+                    # hand successors a garbage placeholder and the pool
+                    # would quiesce "successfully" with wrong numerics —
+                    # the worst failure mode a runtime can have (reference
+                    # treats hook ERROR as fatal, scheduling.c:512).  Fail
+                    # the pool: wait() returns False, successors stay
+                    # unreleased.
+                    self._fail_task_pool(
+                        task, f"device submit failed after retry: {e!r}")
             # phase: get_data_out — retire ready computations in order
             progressed = self._poll_lanes(es)
             with self._lock:
@@ -250,6 +278,22 @@ class TpuDevice(Device):
                         oldest.outputs[0].block_until_ready()
                     except Exception:
                         pass
+
+    def _fail_task_pool(self, task: Task, why: str) -> None:
+        """Device execution failed unrecoverably: fail the task's pool so
+        ``wait()`` returns False.  Reference: hook ERROR is fatal
+        (``scheduling.c:512``); completing with a placeholder would be
+        wrong-answer-with-rc-0.
+
+        LOCAL fail only — no cross-rank abort broadcast from the device
+        layer: this rank cannot know whether the pool is instantiated on
+        peers (a rank-local pool's abort would be PARKED on ranks that
+        never saw the name and replayed into the next same-named healthy
+        pool).  Peers of a genuinely distributed pool discover the loss
+        through the payload/activation paths or their wait() timeout."""
+        from ..comm.remote_dep import _fail_pool
+
+        _fail_pool(task.taskpool, why)
 
     # ------------------------------------------------------------------
     # stage_in / submit
@@ -327,12 +371,16 @@ class TpuDevice(Device):
                     return _body(*arrs, *_vals)
                 jitted = self._jit_cache[key] = jax.jit(
                     _bound, donate_argnums=donate)
+            # a donating call that raises may have invalidated its input
+            # buffers: the task is no longer safely retryable
+            task._tpu_effects = bool(donate)
             outputs = jitted(*arr_args)
         else:
             jitted = self._jit_cache.get(base_key)
             if jitted is None:
                 jitted = self._jit_cache[base_key] = jax.jit(
                     body, donate_argnums=donate)
+            task._tpu_effects = bool(donate)
             outputs = jitted(*dev_args)
         if not isinstance(outputs, (tuple, list)):
             outputs = (outputs,)
@@ -345,7 +393,10 @@ class TpuDevice(Device):
         if self._eager:
             from ..core import scheduling
 
-            self._epilog(inflight)  # raising here falls back to the manager's error completion
+            # the epilog mutates output tiles one by one (rebind +
+            # version bump): once entered, a retry would double-apply
+            task._tpu_effects = True
+            self._epilog(inflight)
             task._tpu_completed = True
             scheduling.complete_execution(self.context, es, task)
             return
@@ -504,9 +555,26 @@ class TpuDevice(Device):
 
         progressed = False
         for lane in self._lanes:
-            while lane and lane[0].ready():
-                inflight = lane.popleft()
-                self._epilog(inflight)
+            while lane:
+                inflight = None
+                try:
+                    if not lane[0].ready():
+                        break
+                    inflight = lane.popleft()
+                    self._epilog(inflight)
+                except Exception as e:
+                    # the async computation itself died (device error
+                    # surfacing at poll) or the epilog could not commit
+                    # outputs: the task must NOT complete — successors
+                    # would consume garbage.  Fail the pool loudly.
+                    if inflight is None:
+                        inflight = lane.popleft()  # ready() raised
+                    debug.error("tpu lane retirement failed: %s", e)
+                    self._fail_task_pool(
+                        inflight.task,
+                        f"device lane retirement raised: {e!r}")
+                    progressed = True
+                    continue
                 scheduling.complete_execution(self.context, es, inflight.task)
                 progressed = True
         return progressed

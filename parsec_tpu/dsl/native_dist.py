@@ -211,22 +211,34 @@ class NativeDistExecutor(NativeExecutor):
 
         def body() -> None:
             nonlocal sends, wbs
-            base()
-            if sends is None:
-                sends = self._remote_out.get(tid, False)
-                wbs = self._remote_wb.get(tid, False)
-            if wbs:
-                for (cname, key, src, owner) in wbs:
-                    payload = None if src is None else \
-                        np.asarray(self._payload(src))
-                    rd.send_writeback(self, cname, key, payload, owner)
-            if sends:
-                rank_masks, payload_src = sends
-                flow_payloads = {
-                    fi: np.asarray(self._payload(sk))
-                    for fi, sk in payload_src.items() if sk is not None}
-                rd.send_activations(self, tid[0], tid[1],
-                                    dict(rank_masks), flow_payloads)
+            if self.failed:
+                return  # drain mode: retire without executing or sending
+            try:
+                base()
+                if sends is None:
+                    sends = self._remote_out.get(tid, False)
+                    wbs = self._remote_wb.get(tid, False)
+                if wbs:
+                    for (cname, key, src, owner) in wbs:
+                        payload = None if src is None else \
+                            np.asarray(self._payload(src))
+                        rd.send_writeback(self, cname, key, payload, owner)
+                if sends:
+                    rank_masks, payload_src = sends
+                    flow_payloads = {
+                        fi: np.asarray(self._payload(sk))
+                        for fi, sk in payload_src.items() if sk is not None}
+                    rd.send_activations(self, tid[0], tid[1],
+                                        dict(rank_masks), flow_payloads)
+            except BaseException as e:
+                # a producer dying BEFORE its sends would strand every
+                # consumer rank's phantoms: fail the pool on every rank
+                # (peers drain via _force_fail's phantom commits), then
+                # re-raise so run() reports the original error
+                rd._fail_pool_everywhere(
+                    self, f"body {tid[0]}{tuple(tid[1])} on rank "
+                    f"{self.rank} raised: {e!r}")
+                raise
 
         return body
 
@@ -262,10 +274,30 @@ class NativeDistExecutor(NativeExecutor):
         self._ng.commit(ph)
 
     def _force_fail(self) -> bool:
-        if self._terminated:
-            return False
-        self._terminated = True
+        # atomic terminating transition (same contract as
+        # Taskpool._force_fail under _term_lock): concurrent failure
+        # paths — a local body raising on a native worker vs a peer abort
+        # on the pump thread — must not both observe the transition, or
+        # _fail_pool_everywhere would broadcast the abort twice
+        with self._net_lock:
+            if self._terminated:
+                return False
+            self._terminated = True
         self.failed = True
+        # Unblock run(): _ng.run() retires tasks, not flags — every
+        # phantom whose commit token the network still holds must commit
+        # now or the native graph never drains and run() blocks forever.
+        # Bodies released this way see self.failed and retire as no-ops,
+        # so no successor consumes a missing remote payload and no
+        # garbage lands in the backing collections.
+        with self._net_lock:
+            phantoms = list(self._phantoms.values())
+            self._phantoms.clear()
+            for phl in self._wb_phantoms.values():
+                phantoms.extend(phl)
+                phl.clear()
+        for ph in phantoms:
+            self._ng.commit(ph)
         return True
 
     # -- execution ---------------------------------------------------------

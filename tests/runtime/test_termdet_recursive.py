@@ -327,3 +327,75 @@ def test_fourcounter_production_wiring_end_to_end():
     assert getattr(ces[0], "_termdet_bound", None) is None
     for c in ctxs:
         c.fini()
+
+
+def test_second_fourcounter_pool_falls_back_to_local():
+    """The CE's TERMDET tag + piggyback channel are single-slot: while one
+    fourcounter pool is bound, a SECOND concurrent fourcounter pool with
+    managed accounting (PTG: auto_count=False) must fall back to LOCAL
+    termdet — an unbound fourcounter has no wave driver and would hang its
+    wait() to the timeout — carrying over the counts attached() already
+    applied; a truly dynamic pool (auto_count) must be refused loudly."""
+    import numpy as np
+
+    from parsec_tpu import Context, Taskpool
+    from parsec_tpu.core.termdet import TermDetLocal
+    from parsec_tpu.data import LocalCollection
+    from parsec_tpu.dsl.ptg import PTG, INOUT
+
+    nranks, n = 2, 6
+    fabric = InprocFabric(nranks)
+    ces = fabric.endpoints()
+    ctxs = [Context(nb_cores=2, rank=r, nranks=nranks, comm=ces[r])
+            for r in range(nranks)]
+    oks = [None] * nranks
+
+    def make_chain(r, name, local=False):
+        dc = LocalCollection(f"D{name}", shape=(4,), nodes=nranks, myrank=r,
+                             init=lambda k: np.zeros(4))
+        dc.rank_of = (lambda *key: 0) if local \
+            else (lambda *key: dc.data_key(*key) % nranks)
+        ptg = PTG(name)
+        step = ptg.task_class("step", k=f"0 .. {n-1}")
+        step.affinity("D(k)")
+        step.flow("X", INOUT,
+                  "<- (k == 0) ? D(0) : X step(k-1)",
+                  f"-> (k < {n-1}) ? X step(k+1) : D(k)")
+        step.body(cpu=lambda X, k: X.__iadd__(1.0))
+        return ptg.taskpool(termdet="fourcounter", D=dc)
+
+    def worker(r):
+        tp1 = make_chain(r, "fc1")
+        tp2 = make_chain(r, "fc2")
+        ctxs[r].add_taskpool(tp1)  # takes the CE slot
+        ctxs[r].add_taskpool(tp2)  # must fall back to local
+        assert isinstance(tp2.tdm, TermDetLocal), type(tp2.tdm).__name__
+        ok1 = tp1.wait(timeout=60)
+        ok2 = tp2.wait(timeout=60)
+        oks[r] = ok1 and ok2
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(nranks)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert all(oks), oks
+
+    for c in ctxs:
+        c.fini()
+
+    # dynamic pools cannot fall back: refuse loudly while the slot is
+    # held (single-rank fabric; a runtime action keeps the holder alive)
+    fabric1 = InprocFabric(1)
+    ctx1 = Context(nb_cores=2, rank=0, nranks=1,
+                   comm=fabric1.endpoints()[0])
+    hold = Taskpool(name="hold", termdet="fourcounter", nb_tasks=0)
+    hold.tdm.taskpool_addto_runtime_actions(hold, 1)  # keep it busy
+    ctx1.add_taskpool(hold)
+    dyn = Taskpool(name="dyn", termdet="fourcounter")
+    assert dyn.auto_count
+    with pytest.raises(RuntimeError, match="fourcounter"):
+        ctx1.add_taskpool(dyn)
+    hold.tdm.taskpool_addto_runtime_actions(hold, -1)
+    assert hold.wait(timeout=60)
+    ctx1.fini()
