@@ -45,6 +45,7 @@ class DataCopy:
         "readers",
         "flags",
         "arena",
+        "staged_by",
     )
 
     def __init__(self, data: "Data", device_index: int, payload: Any = None):
@@ -56,6 +57,10 @@ class DataCopy:
         self.readers: int = 0
         self.flags: int = 0
         self.arena = None  # owning arena, for recycled temp buffers
+        #: the custom stage_in hook that produced this copy's payload, if
+        #: any — a packed/converted representation is only reusable by
+        #: the SAME hook (device/tpu.py _stage_in_custom fast path)
+        self.staged_by = None
 
     @property
     def nbytes(self) -> int:

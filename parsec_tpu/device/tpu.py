@@ -56,12 +56,16 @@ class _InFlight:
     """One submitted computation: outputs pending on a lane (the analogue
     of a recorded stream event)."""
 
-    __slots__ = ("task", "outputs", "out_specs", "host_inputs")
+    __slots__ = ("task", "outputs", "out_specs", "out_hooks", "host_inputs")
 
-    def __init__(self, task: Task, outputs: List[Any], out_specs: List[Tuple[int, Any]]):
+    def __init__(self, task: Task, outputs: List[Any],
+                 out_specs: List[Tuple[int, Any]],
+                 out_hooks: Optional[List[Any]] = None):
         self.task = task
         self.outputs = outputs
         self.out_specs = out_specs  # (flow position in body_args, Data)
+        #: per-output custom stage_out hooks (None = default commit)
+        self.out_hooks = out_hooks or [None] * len(out_specs)
 
     def ready(self) -> bool:
         return all(o.is_ready() for o in self.outputs)
@@ -305,16 +309,28 @@ class TpuDevice(Device):
             # DTD/PTG store the raw device body on the chore at build time
             raise RuntimeError(f"chore of {task!r} has no body_fn for device execution")
 
+        # per-flow custom staging (reference stage_in/stage_out device
+        # hooks, device_gpu.h:62-94), keyed by data-arg order
+        si_hooks = getattr(body, "_stage_in", None) or {}
+        so_hooks = getattr(body, "_stage_out", None) or {}
         dev_args: List[Any] = []
         out_specs: List[Tuple[int, Data]] = []
+        out_hooks: List[Any] = []
+        data_idx = -1
         for pos, spec in enumerate(task.body_args or ()):
             kind, payload, mode = spec
             if kind == "data":
+                data_idx += 1
                 if payload is None:  # optional (guarded-off) flow
                     dev_args.append(None)
                     continue
                 rw = mode & AccessMode.INOUT
-                if rw == AccessMode.OUT:
+                si = si_hooks.get(data_idx)
+                if si is not None:
+                    # custom staging: the hook's result IS the flow's
+                    # device copy (pack/convert — reference stage_custom)
+                    arr = self._stage_in_custom(payload, si)
+                elif rw == AccessMode.OUT:
                     # write-only: the body overwrites it — skip the H2D
                     # transfer (reference skips stage-in for OUT-only flows)
                     arr = self._out_placeholder(payload)
@@ -324,6 +340,7 @@ class TpuDevice(Device):
                 dev_args.append(arr)
                 if mode & AccessMode.OUT:
                     out_specs.append((pos, payload))
+                    out_hooks.append(so_hooks.get(data_idx))
             elif kind == "value":
                 dev_args.append(payload)
             elif kind == "scratch":
@@ -389,7 +406,7 @@ class TpuDevice(Device):
             raise ValueError(
                 f"device body of {task!r} returned {len(outputs)} outputs "
                 f"for {len(out_specs)} writable flows")
-        inflight = _InFlight(task, outputs, out_specs)
+        inflight = _InFlight(task, outputs, out_specs, out_hooks)
         if self._eager:
             from ..core import scheduling
 
@@ -414,6 +431,34 @@ class TpuDevice(Device):
         # committed to THIS rank's device: an uncommitted zeros array
         # would pull the computation onto the process default device
         return jax.device_put(jnp.zeros(shape, dtype), self.jdev)
+
+    def _stage_in_custom(self, data: Data, hook) -> Any:
+        """Stage via a user hook: ``hook(data, device) -> jax.Array``.
+        The hook's result becomes the flow's device copy (the reference's
+        stage_in writes into the GPU copy buffer the same way); residency
+        is accounted at the STAGED size, which may differ from the home
+        tile's (packed subtile)."""
+        mine = data.get_copy(self.data_index)
+        newest = data.newest_copy()
+        if mine is not None and newest is not None \
+                and mine.version >= newest.version and mine.payload is not None \
+                and getattr(mine, "staged_by", None) is hook:
+            # reusable ONLY if this same hook produced it: a current
+            # device copy staged by the default path (prefetch, a prior
+            # epilog) holds the HOME representation, not the packed one
+            self._lru_touch(data, dirty=mine.coherency is Coherency.OWNED)
+            return mine.payload
+        arr = hook(data, self)
+        old = mine.nbytes if (mine is not None and mine.payload is not None) else 0
+        self._hbm_realloc(data, old, arr.nbytes)
+        arr = jax.device_put(arr, self.jdev)
+        self.stats["bytes_in"] += arr.nbytes
+        self.stats["custom_stage_in"] = self.stats.get("custom_stage_in", 0) + 1
+        c = data.attach_copy(self.data_index, arr)
+        c.version = newest.version if newest is not None else 0
+        c.staged_by = hook
+        self._lru_touch(data, dirty=False)
+        return arr
 
     def _stage_in(self, data: Data) -> Any:
         """Materialize the newest version of ``data`` on this device."""
@@ -582,14 +627,26 @@ class TpuDevice(Device):
     def _epilog(self, inflight: _InFlight) -> None:
         """Commit outputs: rebind device copies, bump versions, keep tiles
         resident & dirty (reference kernel_epilog device_gpu.c:2343 — data
-        stays OWNED on device; host pulls on demand)."""
-        for (pos, data), arr in zip(inflight.out_specs, inflight.outputs):
+        stays OWNED on device; host pulls on demand).  A flow's custom
+        stage_out hook transforms the body output first (scatter a packed
+        subtile back — reference stage_custom.jdf)."""
+        for (pos, data), arr, so in zip(inflight.out_specs,
+                                        inflight.outputs,
+                                        inflight.out_hooks):
+            if so is not None:
+                # commit to THIS device: a hook building from host data
+                # would otherwise land on the process default device
+                arr = jax.device_put(so(arr, data, self), self.jdev)
+                self.stats["custom_stage_out"] = self.stats.get("custom_stage_out", 0) + 1
             c = data.get_copy(self.data_index)
             old = c.nbytes if c is not None else 0
             if c is None:
                 c = data.attach_copy(self.data_index, arr)
             else:
                 c.payload = arr
+            # the committed value is HOME-layout (stage_out already
+            # unpacked): a packed stage_in marker must not survive it
+            c.staged_by = None
             self._hbm_realloc(data, old, arr.nbytes)
             data.version_bump(self.data_index)
             self._lru_touch(data, dirty=True)

@@ -149,16 +149,23 @@ def _split_top(s: str, sep: str) -> List[str]:
 
 
 class _ArgExpr:
-    """Scalar expression or inclusive range ``lo .. hi``."""
+    """Scalar expression or inclusive range ``lo .. hi`` with optional
+    stride ``lo .. hi .. step`` (reference jdf_expr ranges — e.g.
+    strange.jdf's ``step = 0 .. N .. (N+1)``, a stride larger than the
+    span yielding a single value; udf.jdf strides through inline calls
+    whose side effect counts enumerations)."""
 
-    __slots__ = ("lo", "hi")
+    __slots__ = ("lo", "hi", "step")
 
     def __init__(self, src: str):
         parts = _split_top(src, "..")
         if len(parts) == 1:
-            self.lo, self.hi = _Expr(parts[0]), None
+            self.lo, self.hi, self.step = _Expr(parts[0]), None, None
         elif len(parts) == 2:
+            self.lo, self.hi, self.step = _Expr(parts[0]), _Expr(parts[1]), None
+        elif len(parts) == 3:
             self.lo, self.hi = _Expr(parts[0]), _Expr(parts[1])
+            self.step = _Expr(parts[2])
         else:
             raise ValueError(f"bad range expression {src!r}")
 
@@ -166,7 +173,11 @@ class _ArgExpr:
         if self.hi is None:
             v = self.lo(env)
             return v if isinstance(v, range) else (v,)
-        return range(int(self.lo(env)), int(self.hi(env)) + 1)
+        step = 1 if self.step is None else int(self.step(env))
+        if step <= 0:
+            raise ValueError(
+                f"range {self.lo.src}..{self.hi.src} stride must be positive")
+        return range(int(self.lo(env)), int(self.hi(env)) + 1, step)
 
     def scalar(self, env: Dict[str, Any]) -> Any:
         if self.hi is not None:
@@ -332,6 +343,12 @@ class PTGTaskClass:
         self._priority: Optional[_Expr] = None
         self.bodies: Dict[str, Callable] = {}
         self.properties: Dict[str, Any] = {}
+        #: per-device incarnation applicability predicates (reference
+        #: BODY [evaluate = fn]: HOOK_RETURN_NEXT skips the incarnation)
+        self.chore_evaluate: Dict[str, Callable] = {}
+        #: flow name -> (stage_in, stage_out) custom device staging
+        self.stage_hooks: Dict[str, Tuple[Optional[Callable],
+                                          Optional[Callable]]] = {}
         #: taskpool-constant names passed to bodies by name (JDF globals
         #: are visible inside reference BODY blocks as C globals)
         self.body_globals: List[str] = []
@@ -389,6 +406,31 @@ class PTGTaskClass:
         if tpu is not None:
             self.bodies[DEV_TPU] = tpu
         self.bodies.update(others)
+        return self
+
+    def evaluate_hook(self, device: str, fn: Callable) -> "PTGTaskClass":
+        """Attach an applicability predicate to one device's incarnation
+        (reference BODY ``[evaluate = fn]``, ``jdf_body_t`` evaluate
+        property): ``fn(task) -> bool``; False skips this incarnation at
+        device selection, like a HOOK_RETURN_NEXT evaluate."""
+        self.chore_evaluate[device] = fn
+        return self
+
+    def stage(self, flow_name: str, stage_in: Optional[Callable] = None,
+              stage_out: Optional[Callable] = None) -> "PTGTaskClass":
+        """Custom per-flow device staging (reference BODY
+        ``stage_in=``/``stage_out=`` properties reaching the GPU task,
+        ``device_gpu.h:62-94``; ``tests/runtime/cuda/stage_custom.jdf``).
+
+        ``stage_in(data, device) -> jax.Array`` replaces the default
+        whole-tile H2D staging — pack a strided subtile, convert layout,
+        quantize — and its result becomes the flow's device copy.
+        ``stage_out(array, data, device) -> jax.Array`` transforms the
+        body's output for that flow before it is committed as the new
+        device copy (e.g. scatter the packed subtile back)."""
+        if flow_name not in {f.name for f in self.flows}:
+            raise ValueError(f"class {self.name}: no flow {flow_name!r}")
+        self.stage_hooks[flow_name] = (stage_in, stage_out)
         return self
 
     # -- evaluation over a constants dict --------------------------------
@@ -622,6 +664,7 @@ class PTGTaskpool(Taskpool):
             else:
                 chore = Chore(dev_type, _accel_hook)
                 chore.body_fn = _wrap_device_body(pc, fn)
+            chore.evaluate = pc.chore_evaluate.get(dev_type)
             tc.add_chore(chore)
         self._built[pc.name] = tc
         self.add_task_class(tc)
@@ -1149,6 +1192,18 @@ def _wrap_device_body(pc: PTGTaskClass, fn: Callable):
     for attr in ("_static_values", "_donate_args"):
         if hasattr(fn, attr):
             setattr(wrapped, attr, getattr(fn, attr))
+    if pc.stage_hooks:
+        # per-flow custom staging, indexed by the data-arg position the
+        # device module sees (non-CTL flow declaration order)
+        data_flows = [f.name for f in pc.flows if f.mode != CTL]
+        wrapped._stage_in = {
+            i: si for i, name in enumerate(data_flows)
+            for si, _ in (pc.stage_hooks.get(name, (None, None)),)
+            if si is not None}
+        wrapped._stage_out = {
+            i: so for i, name in enumerate(data_flows)
+            for _, so in (pc.stage_hooks.get(name, (None, None)),)
+            if so is not None}
     return wrapped
 
 

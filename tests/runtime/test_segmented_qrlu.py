@@ -72,3 +72,28 @@ def test_segmented_qr_two_flow_residency(ctx):
     np.asarray(Q), np.asarray(R)
     assert sq.device.stats["bytes_in"] == 0
     assert not sq.device._lru_dirty and not sq.device._lru_clean
+
+
+def test_generic_partial_strip_coverage(ctx):
+    """Regression: the generic bodies' chunk grid must cover the partial
+    last strip when strip does not divide n (rows/cols past the last
+    full strip boundary were silently skipped)."""
+    import numpy as np
+
+    from parsec_tpu.ops.segmented_chol import SegmentedCholesky
+    from parsec_tpu.ops.segmented_lu import SegmentedLU
+    from parsec_tpu.ops.segmented_qr import SegmentedQR
+
+    n, nb, strip = 384, 64, 256  # 1.5 strips
+    rng = np.random.default_rng(5)
+    A = rng.standard_normal((n, n)).astype(np.float32)
+    SPD = A @ A.T + n * np.eye(n, dtype=np.float32)
+    Add = (rng.standard_normal((n, n)) + n * np.eye(n)).astype(np.float32)
+    sc = SegmentedCholesky(ctx, n, nb, strip=strip, tail=0,
+                           specialize="generic")
+    L = sc(SPD)
+    assert np.abs(L - np.linalg.cholesky(SPD)).max() / n < 1e-3
+    Q, R = SegmentedQR(ctx, n, nb, strip=strip)(A)
+    assert np.abs(Q @ R - A).max() / np.abs(A).max() < 1e-3
+    Lu, U = SegmentedLU(ctx, n, nb, strip=strip, tail=0)(Add)
+    assert np.abs(Lu @ U - Add).max() / np.abs(Add).max() < 1e-3

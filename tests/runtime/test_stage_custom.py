@@ -1,0 +1,132 @@
+"""Custom per-flow stage_in/stage_out device hooks.
+
+Reference: ``tests/runtime/cuda/stage_custom.jdf:185-186`` +
+``parsec/mca/device/device_gpu.h:62-94`` — a task overrides how a flow's
+data is staged into/out of device memory (pack a strided subtile,
+convert layout).  Here: ``stage_in(data, device) -> array`` makes the
+flow's device copy; ``stage_out(array, data, device) -> array``
+transforms the body output before it is committed.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from parsec_tpu import Context, DEV_TPU
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl.ptg import INOUT, PTG
+
+
+@pytest.fixture
+def ctx():
+    c = Context(nb_cores=2)
+    yield c
+    c.fini()
+
+
+def tpu_dev(ctx):
+    for d in ctx.devices:
+        if d.mca_name == "tpu":
+            return d
+    pytest.skip("no jax device available")
+
+
+def test_ptg_stage_hooks_pack_strided_subtile(ctx):
+    """The device body sees a PACKED even-column subtile (half the HBM
+    of the full tile); stage_out scatters the result back into the full
+    layout.  The odd columns must be preserved untouched."""
+    dev = tpu_dev(ctx)
+    N, NT = 8, 3
+    dc = LocalCollection(
+        "A", shape=(N, N),
+        init=lambda k: np.arange(N * N, dtype=np.float64).reshape(N, N))
+
+    calls = {"in": 0, "out": 0}
+
+    def pack_even_cols(data, device):
+        calls["in"] += 1
+        host = np.asarray(data.newest_copy().payload)
+        return jnp.asarray(host[:, ::2])  # strided subtile, packed
+
+    def scatter_back(arr, data, device):
+        # the staged device copy is the PACKED subtile; the home layout
+        # lives in the host copy (reference stage_out sees both buffers)
+        calls["out"] += 1
+        full = jnp.asarray(np.asarray(data.get_copy(0).payload))
+        return full.at[:, ::2].set(arr)
+
+    ptg = PTG("stagec")
+    t = ptg.task_class("t", k=f"0 .. {NT-1}")
+    t.affinity("A(k)")
+    t.flow("X", INOUT, "<- A(k)", "-> A(k)")
+    t.stage("X", stage_in=pack_even_cols, stage_out=scatter_back)
+    t.body(tpu=lambda X, k: X * 10.0)
+    tp = ptg.taskpool(A=dc)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=60)
+    assert calls["in"] == NT and calls["out"] == NT
+    assert dev.stats.get("custom_stage_in", 0) == NT
+    assert dev.stats.get("custom_stage_out", 0) == NT
+    base = np.arange(N * N, dtype=np.float64).reshape(N, N)
+    expect = base.copy()
+    expect[:, ::2] *= 10.0  # even columns transformed, odd untouched
+    for k in range(NT):
+        from parsec_tpu.dsl.dtd import stage_to_cpu
+
+        np.testing.assert_allclose(stage_to_cpu(dc.data_of(k)), expect)
+
+
+def test_jdf_stage_properties(ctx):
+    """The JDF surface: BODY [stage_in = fn stage_out = fn] properties
+    reach the device module (reference stage_custom.jdf syntax)."""
+    from parsec_tpu.dsl import compile_jdf
+
+    tpu_dev(ctx)
+    N = 4
+    src = """
+A  [ type = "collection" ]
+NT [ type = int ]
+
+t(k)
+
+k = 0 .. NT-1
+
+: A( k )
+
+RW X <- A( k )
+     -> A( k )
+
+BODY [ type = TPU
+       stage_in = pack_half
+       stage_out = unpack_half ]
+{
+    return X + 1.0
+}
+END
+"""
+
+    def pack_half(data, device):
+        import jax.numpy as _jnp
+
+        host = np.asarray(data.newest_copy().payload)
+        return _jnp.asarray(host[: len(host) // 2])
+
+    def unpack_half(arr, data, device):
+        import jax.numpy as _jnp
+
+        full = _jnp.asarray(np.asarray(data.get_copy(0).payload))
+        return full.at[: full.shape[0] // 2].set(arr)
+
+    jdf = compile_jdf(src, "stagejdf", namespace={
+        "pack_half": pack_half, "unpack_half": unpack_half})
+    dc = LocalCollection("A", shape=(N,), init=lambda k: np.zeros(N))
+    tp = jdf.new(A=dc, NT=2)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=60)
+    from parsec_tpu.dsl.dtd import stage_to_cpu
+
+    for k in range(2):
+        got = stage_to_cpu(dc.data_of(k))
+        np.testing.assert_allclose(got, [1.0, 1.0, 0.0, 0.0])
