@@ -636,9 +636,43 @@ def qrlu_stage(n: int, nb: int, measure, fields: dict) -> None:
         finally:
             ctx.fini()
 
+    def lu_bf16storage_leg():
+        """The cholesky bandwidth lever applied to getrf: the matrix
+        lives in bf16 (HALF the HBM traffic of f32 storage), panel math
+        upcast to f32.  Honestly labeled: its own _bf16storage field,
+        the 1e-2 bf16-class bar, recorded err — never merged into the
+        f32 number.  The gate input stays the SAME dd matrix as the f32
+        leg (block-local pivoting's stability envelope)."""
+        import jax.numpy as jnp
+
+        ctx = Context(nb_cores=nb_cores)
+        try:
+            # static specialization: measured 23.5 TF vs generic's 19.0
+            # at this config (compile 20.7s, inside budget)
+            sl = SegmentedLU(ctx, n, nb, tail=8192, bf16="storage",
+                             specialize="static")
+            to_f32 = jax.jit(lambda x: x.astype(jnp.float32))
+            A_b = jax.jit(lambda x: x.astype(jnp.bfloat16))(A_lu)
+            err_b = float(gate_lu(to_f32(sl.run(copy(A_b)))))
+            if not np.isfinite(err_b) or err_b > 1e-2:
+                raise RuntimeError(
+                    f"bf16-storage LU numerics off ({err_b})")
+            fields["runtime_lu_bf16storage_err"] = float(f"{err_b:.2e}")
+            t_copy = measure(lambda: copy(A_b), 2)
+            k = f"runtime_lu_N{n}_nb{nb}_bf16storage_gflops"
+            for _ in range(2):
+                t_l = _minus_cost(
+                    measure(lambda: sl.run(copy(A_b)), 2), t_copy)
+                fields[k] = max(fields.get(k, 0.0),
+                                round(2 / 3 * n**3 / t_l / 1e9, 2))
+        finally:
+            ctx.fini()
+
     _leg(fields, "qr", qr_leg)
     if not _over_budget(0.90, "lu leg"):
         _leg(fields, "lu", lu_leg)
+    if not _over_budget(0.95, "lu bf16-storage leg"):
+        _leg(fields, "lu_bf16storage", lu_bf16storage_leg)
 
 
 if __name__ == "__main__":

@@ -75,7 +75,14 @@ struct Graph {
     //: compares it against the epoch seen before the pop miss, closing
     //: the lost-wakeup window between pop_ready and wait_for
     std::atomic<uint64_t> push_epoch{0};
+    //: per-worker VP (locality domain) ids, set via pz_graph_set_vpmap:
+    //: steal walks the SAME-VP ring first, then crosses domains — the
+    //: reference lfq's multi-level hbbuffer hierarchy
+    //: (sched_local_queues_utils.h:22-36), collapsed to its two
+    //: meaningful levels (VP-local, global)
+    std::vector<int32_t> vp_of;
     std::atomic<int64_t> n_steals{0};
+    std::atomic<int64_t> n_steals_remote{0};  // cross-VP subset
     std::atomic<int64_t> n_executed{0};
     std::atomic<int64_t> n_inserted{0};
     std::atomic<bool> sealed{false};
@@ -138,14 +145,27 @@ int64_t pop_ready(Graph* g, int32_t wid) {
     }
     size_t nw = g->wqs.size();
     if (wid >= 0 && nw > 1) {
-        for (size_t d = 1; d < nw; ++d) {
-            WorkerQ& v = g->wqs[(static_cast<size_t>(wid) + d) % nw];
-            std::unique_lock<std::mutex> lk(v.mu, std::try_to_lock);
-            if (!lk.owns_lock() || v.heap.empty()) continue;
-            int64_t id = v.heap.top().second;
-            v.heap.pop();
-            g->n_steals.fetch_add(1, std::memory_order_relaxed);
-            return id;
+        // hierarchical steal: pass 0 visits only same-VP victims (the
+        // reference walks its NUMA hierarchy bottom-up), pass 1 crosses
+        // domains; without a vpmap the single pass is the flat ring
+        const bool have_vp = g->vp_of.size() == nw;
+        const int32_t myvp = have_vp ? g->vp_of[wid] : 0;
+        const int passes = have_vp ? 2 : 1;
+        for (int pass = 0; pass < passes; ++pass) {
+            for (size_t d = 1; d < nw; ++d) {
+                size_t vi = (static_cast<size_t>(wid) + d) % nw;
+                if (have_vp && ((g->vp_of[vi] == myvp) != (pass == 0)))
+                    continue;
+                WorkerQ& v = g->wqs[vi];
+                std::unique_lock<std::mutex> lk(v.mu, std::try_to_lock);
+                if (!lk.owns_lock() || v.heap.empty()) continue;
+                int64_t id = v.heap.top().second;
+                v.heap.pop();
+                g->n_steals.fetch_add(1, std::memory_order_relaxed);
+                if (pass == 1)
+                    g->n_steals_remote.fetch_add(1, std::memory_order_relaxed);
+                return id;
+            }
         }
     }
     return -1;
@@ -291,6 +311,19 @@ void pz_graph_set_policy(void* gp, int32_t policy) {
 
 int64_t pz_graph_steals(void* gp) {
     return static_cast<Graph*>(gp)->n_steals.load(std::memory_order_relaxed);
+}
+
+int64_t pz_graph_steals_remote(void* gp) {
+    return static_cast<Graph*>(gp)->n_steals_remote.load(
+        std::memory_order_relaxed);
+}
+
+// Assign each worker (by id, for the NEXT run) to a VP / locality
+// domain: steal prefers same-VP victims (reference vpmap +
+// sched_local_queues_utils.h hierarchy).
+void pz_graph_set_vpmap(void* gp, const int32_t* vp, int64_t n) {
+    Graph* g = static_cast<Graph*>(gp);
+    g->vp_of.assign(vp, vp + n);
 }
 
 // No more tasks will be inserted; run() returns once everything executed.

@@ -5,6 +5,8 @@ from .matrix import (
     LOWER,
     UPPER,
     SymTwoDimBlockCyclic,
+    SymTwoDimBlockCyclicBand,
+    TwoDimBlockCyclicBand,
     TiledMatrix,
     TwoDimBlockCyclic,
     TwoDimTabular,
@@ -20,6 +22,8 @@ __all__ = [
     "TiledMatrix",
     "TwoDimBlockCyclic",
     "SymTwoDimBlockCyclic",
+    "SymTwoDimBlockCyclicBand",
+    "TwoDimBlockCyclicBand",
     "TwoDimTabular",
     "VectorTwoDimCyclic",
     "apply_taskpool",

@@ -213,3 +213,83 @@ class TwoDimTabular(TiledMatrix):
         if callable(self._rank_table):
             return int(self._rank_table(*k))
         return int(self._rank_table[k])
+
+
+class TwoDimBlockCyclicBand(TiledMatrix):
+    """Composite band distribution (reference
+    ``two_dim_rectangle_cyclic_band.{c,h}``): tiles within
+    ``|i - j| < band_size`` of the diagonal delegate to the ``band``
+    sub-distribution with the remapped row ``i - j + band_size - 1``
+    (so the band is stored as a (2*band_size-1, NT) rectangle); all
+    other tiles delegate to ``off_band``.  Storage lives in the
+    sub-collections — this wrapper only routes."""
+
+    def __init__(self, band: TiledMatrix, off_band: TiledMatrix,
+                 band_size: int):
+        super().__init__(off_band.m, off_band.n, off_band.mb, off_band.nb,
+                         name=f"{off_band.name}_band",
+                         nodes=off_band.nodes, myrank=off_band.myrank,
+                         dtype=off_band.default_dtype)
+        if band_size < 1:
+            raise ValueError("band_size must be >= 1")
+        self.band, self.off_band, self.band_size = band, off_band, band_size
+
+    def _band_row(self, i: int, j: int) -> int:
+        return i - j + self.band_size - 1
+
+    def _in_band(self, i: int, j: int) -> bool:
+        return abs(i - j) < self.band_size
+
+    def rank_of(self, *key) -> int:
+        i, j = self.data_key(*key)
+        if self._in_band(i, j):
+            return self.band.rank_of(self._band_row(i, j), j)
+        return self.off_band.rank_of(i, j)
+
+    def vpid_of(self, *key) -> int:
+        i, j = self.data_key(*key)
+        if self._in_band(i, j):
+            return self.band.vpid_of(self._band_row(i, j), j)
+        return self.off_band.vpid_of(i, j)
+
+    def data_of(self, *key):
+        i, j = self.data_key(*key)
+        if self._in_band(i, j):
+            return self.band.data_of(self._band_row(i, j), j)
+        return self.off_band.data_of(i, j)
+
+
+class SymTwoDimBlockCyclicBand(TiledMatrix):
+    """Symmetric band composite (reference
+    ``sym_two_dim_rectangle_cyclic_band.{c,h}``): band tiles remap to
+    row ``|i - j|`` of the ``band`` sub-distribution (band stored as a
+    (band_size, NT) rectangle); off-band tiles delegate to the
+    symmetric ``off_band`` distribution."""
+
+    def __init__(self, band: TiledMatrix, off_band: TiledMatrix,
+                 band_size: int):
+        super().__init__(off_band.m, off_band.n, off_band.mb, off_band.nb,
+                         name=f"{off_band.name}_symband",
+                         nodes=off_band.nodes, myrank=off_band.myrank,
+                         dtype=off_band.default_dtype)
+        if band_size < 1:
+            raise ValueError("band_size must be >= 1")
+        self.band, self.off_band, self.band_size = band, off_band, band_size
+
+    def rank_of(self, *key) -> int:
+        i, j = self.data_key(*key)
+        if abs(i - j) < self.band_size:
+            return self.band.rank_of(abs(i - j), j)
+        return self.off_band.rank_of(i, j)
+
+    def vpid_of(self, *key) -> int:
+        i, j = self.data_key(*key)
+        if abs(i - j) < self.band_size:
+            return self.band.vpid_of(abs(i - j), j)
+        return self.off_band.vpid_of(i, j)
+
+    def data_of(self, *key):
+        i, j = self.data_key(*key)
+        if abs(i - j) < self.band_size:
+            return self.band.data_of(abs(i - j), j)
+        return self.off_band.data_of(i, j)

@@ -326,6 +326,14 @@ class TpuDevice(Device):
                     continue
                 rw = mode & AccessMode.INOUT
                 si = si_hooks.get(data_idx)
+                if si is not None and (mode & AccessMode.OUT) \
+                        and so_hooks.get(data_idx) is None:
+                    # the body would compute on the PACKED representation
+                    # and the epilog would commit it as the home-layout
+                    # tile — silently wrong; loud is the contract
+                    raise RuntimeError(
+                        f"{task!r}: stage_in on writable flow requires a "
+                        "matching stage_out hook")
                 if si is not None:
                     # custom staging: the hook's result IS the flow's
                     # device copy (pack/convert — reference stage_custom)
@@ -448,6 +456,16 @@ class TpuDevice(Device):
             # epilog) holds the HOME representation, not the packed one
             self._lru_touch(data, dirty=mine.coherency is Coherency.OWNED)
             return mine.payload
+        if mine is not None and mine.payload is not None \
+                and getattr(mine, "staged_by", None) is None:
+            host = data.get_copy(0)
+            if host is None or host.payload is None \
+                    or host.version < mine.version:
+                # the device copy is the ONLY up-to-date home-layout
+                # replica: flush it home BEFORE the packed staging
+                # replaces it, or that data exists nowhere (and the
+                # hook itself typically reads the host copy)
+                self._writeback(data)
         arr = hook(data, self)
         old = mine.nbytes if (mine is not None and mine.payload is not None) else 0
         self._hbm_realloc(data, old, arr.nbytes)
@@ -462,8 +480,14 @@ class TpuDevice(Device):
 
     def _stage_in(self, data: Data) -> Any:
         """Materialize the newest version of ``data`` on this device."""
-        newest = data.newest_copy()
         mine = data.get_copy(self.data_index)
+        if mine is not None and getattr(mine, "staged_by", None) is not None:
+            # a custom-staged PACKED representation must never be served
+            # as the home layout: drop it and restage from the host copy
+            # (which _stage_in_custom flushed to the same version)
+            self._drop_copy(data, evicted=False)
+            mine = None
+        newest = data.newest_copy()
         if mine is not None and newest is not None and mine.version >= newest.version and mine.payload is not None:
             self._lru_touch(data, dirty=mine.coherency is Coherency.OWNED)
             return mine.payload
@@ -576,6 +600,11 @@ class TpuDevice(Device):
         ``parsec_gpu_create_w2r_task``)."""
         c = data.get_copy(self.data_index)
         if c is None or c.payload is None:
+            return
+        if getattr(c, "staged_by", None) is not None:
+            # packed custom-staged representation: flushing it home would
+            # corrupt the home tile; the host copy already holds the same
+            # version in home layout (_stage_in_custom pre-flushes)
             return
         host = np.asarray(c.payload)  # D2H
         if not host.flags.writeable:

@@ -592,43 +592,41 @@ class JDF:
                         f"task {tc.name}: duplicate BODY for device {dev!r}")
                 bodies[dev] = _compile_body(
                     b, tc, self.namespace, self.ast.name, body_globals)
-                for prop, slot in (("stage_in", 0), ("stage_out", 1)):
+                def hook_prop(prop: str):
+                    """A BODY property naming a callable in the prologue
+                    namespace (evaluate=/stage_in=/stage_out=)."""
                     expr = b.props.get(prop)
                     if not expr:
-                        continue
-                    # reference BODY stage_in=/stage_out= properties
-                    # (stage_custom.jdf:185-186): custom device staging,
-                    # applied to every data flow of the class
+                        return None
                     try:
-                        hook = eval(expr, dict(self.namespace))  # noqa: S307
+                        fn = eval(expr, dict(self.namespace))  # noqa: S307
                     except Exception as e:
                         raise ValueError(
                             f"task {tc.name}: BODY {prop}={expr!r}: {e}")
-                    if not callable(hook):
+                    if not callable(fn):
                         raise ValueError(
                             f"task {tc.name}: BODY {prop}={expr!r} is not "
                             "callable")
+                    return fn
+
+                # reference BODY stage_in=/stage_out= properties
+                # (stage_custom.jdf:185-186): custom device staging,
+                # applied to every data flow of the class
+                for prop, slot in (("stage_in", 0), ("stage_out", 1)):
+                    hook = hook_prop(prop)
+                    if hook is None:
+                        continue
                     for f in tc.flows:
                         if _MODES[f.mode] == CTL:
                             continue
                         cur = pc.stage_hooks.get(f.name, (None, None))
                         pair = (hook, cur[1]) if slot == 0 else (cur[0], hook)
                         pc.stage(f.name, *pair)
-                ev = b.props.get("evaluate")
-                if ev:
-                    # reference BODY [evaluate = fn]: an incarnation
-                    # applicability predicate (HOOK_RETURN_NEXT skips it);
-                    # the value is a prologue/namespace expression
-                    try:
-                        fn = eval(ev, dict(self.namespace))  # noqa: S307
-                    except Exception as e:
-                        raise ValueError(
-                            f"task {tc.name}: BODY evaluate={ev!r}: {e}")
-                    if not callable(fn):
-                        raise ValueError(
-                            f"task {tc.name}: BODY evaluate={ev!r} is not "
-                            "callable")
-                    pc.evaluate_hook(dev, fn)
+                # reference BODY [evaluate = fn]: incarnation
+                # applicability predicate (HOOK_RETURN_NEXT skips it)
+                ev_fn = hook_prop("evaluate")
+                if ev_fn is not None:
+                    pc.evaluate_hook(dev, ev_fn)
             pc.body(**bodies)
         return ptg
 

@@ -178,17 +178,44 @@ class NativeExecutor:
         return body
 
     def run(self, nthreads: int = 4) -> int:
-        """Execute to quiescence; returns the number of tasks run."""
+        """Execute to quiescence; returns the number of tasks run.
+        Honors the ``runtime_vpmap`` MCA param: workers split into VP
+        locality domains and the native steal path prefers same-VP
+        victims (reference lfq hierarchy)."""
         bodies = self._bodies
 
         def trampoline(_task_id: int, user_tag: int) -> None:
             bodies[user_tag]()
 
+        self._apply_vpmap(nthreads)
         n = self._ng.run(trampoline, nthreads=nthreads)
         if n != len(bodies):
             raise RuntimeError(
                 f"native engine retired {n}/{len(bodies)} tasks")
         return n
+
+    def _apply_vpmap(self, nthreads: int) -> None:
+        from ..utils import mca_param
+        from ..utils.binding import VPMap
+
+        spec = str(mca_param.register(
+            "runtime", "vpmap", "flat",
+            help="virtual-process map: flat | nb:K | explicit '0,1;2,3'"))
+        try:
+            if spec.startswith("nb:"):
+                k = int(spec[3:])
+                if k < 1:
+                    raise ValueError("nb:K needs K >= 1")
+                vm = VPMap.from_nb_vps(nthreads, k)
+            elif ";" in spec or "," in spec:
+                vm = VPMap.from_spec(spec)
+            else:
+                return  # flat: no hierarchy to express
+        except Exception as e:
+            # loud: a silently-flat run would masquerade as a perfect-
+            # locality hierarchical measurement (steals_remote == 0)
+            raise ValueError(f"invalid runtime_vpmap {spec!r}: {e}")
+        self._ng.set_vpmap([vm.vp_of(w) for w in range(nthreads)])
 
     def close(self) -> None:
         ng = getattr(self, "_ng", None)

@@ -118,6 +118,10 @@ def _load():
         lib.pz_graph_set_policy.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.pz_graph_steals.restype = ctypes.c_int64
         lib.pz_graph_steals.argtypes = [ctypes.c_void_p]
+        lib.pz_graph_steals_remote.restype = ctypes.c_int64
+        lib.pz_graph_steals_remote.argtypes = [ctypes.c_void_p]
+        lib.pz_graph_set_vpmap.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
         lib.pz_graph_run_noop.restype = ctypes.c_int64
         lib.pz_graph_run_noop.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.pz_graph_order.restype = ctypes.c_int64
@@ -250,6 +254,20 @@ class NativeGraph:
     @property
     def steals(self) -> int:
         return self._lib.pz_graph_steals(self._g)
+
+    @property
+    def steals_remote(self) -> int:
+        """Cross-VP subset of ``steals`` (0 without a vpmap)."""
+        return self._lib.pz_graph_steals_remote(self._g)
+
+    def set_vpmap(self, vp_of_worker) -> None:
+        """Assign each worker id (of the NEXT ``run``) to a VP/locality
+        domain: the steal path walks same-VP victims first, then crosses
+        domains (reference lfq hbbuffer hierarchy + vpmap,
+        ``sched_local_queues_utils.h:22-36``)."""
+        n = len(vp_of_worker)
+        arr = (ctypes.c_int32 * n)(*[int(v) for v in vp_of_worker])
+        self._lib.pz_graph_set_vpmap(self._g, arr, n)
 
     def run_noop(self, nthreads: int = 2) -> int:
         """Dispatch-bound run with a NATIVE no-op body (no GIL): isolates

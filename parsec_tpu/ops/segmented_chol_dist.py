@@ -1,0 +1,229 @@
+"""DISTRIBUTED panel-segmented Cholesky: the north-star formulation
+(ops/segmented_chol.py — panel-granular tasks through the runtime) spread
+over ranks, with the panel column broadcast as a DEVICE-NATIVE payload.
+
+Layout: 1D block-cyclic by column-panel — rank_of(j) = j % R; each rank
+holds its column blocks as full-height (n, nb) tiles.  Per step k:
+
+    panel(k)   on owner(k): L_kk = chol(D_k); column solve; the factored
+               full-height column P broadcasts to every rank owning a
+               trailing block (the runtime's activation broadcast trees,
+               payloads riding the wire as jax Arrays on device-capable
+               fabrics — no host bounce);
+    upd(k, j)  on owner(j), j > k: C_j -= P  P[j-rows]^T — one MXU gemm
+               per (k, j); feeds panel(j) when j == k+1, else upd(k+1, j).
+
+Junk-row discipline (the TPU-functional trick shared with the generic
+single-rank bodies): the column solve runs at FULL height, rows above the
+panel are zeroed in the stored factor, and the trailing update touches
+full columns — every out-of-range row lands in the strictly-upper
+triangle, which no cholesky step reads and the assembly tril()s away.
+
+Reference parity: the 2D block-cyclic tiled dpotrf (examples/tests) is
+the reference's shape; THIS module is the panel-granular segmented
+variant at distributed scale — the round-3 VERDICT #7 artifact
+(BASELINE.json's overlap config counts dpotrf panels against halo
+traffic).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.lifecycle import AccessMode
+from ..dsl.ptg import PTG
+
+try:
+    import jax
+    import jax.numpy as jnp
+except Exception:  # pragma: no cover
+    jax = None
+
+IN = AccessMode.IN
+INOUT = AccessMode.INOUT
+OUT = AccessMode.OUT
+
+
+def _make_panel_body(n: int, nb: int):
+    def panel(M, P, k):
+        k = int(k)  # static under _static_values
+        k0 = k * nb
+        f32 = M.dtype
+        D = M[k0:k0 + nb, :]
+        L = jnp.linalg.cholesky(D)
+        W = jax.lax.linalg.triangular_solve(
+            L, jnp.eye(nb, dtype=f32), lower=True, left_side=True)
+        C = jnp.matmul(M, W.T)          # full-height column solve
+        C = C.at[k0:k0 + nb, :].set(jnp.tril(L))
+        C = C.at[:k0, :].set(0.0)       # junk rows above the panel: zero
+        return C, C  # M' (home block) and P' (the broadcast payload)
+
+    panel._static_values = True
+    panel._jit_key = ("segchol_dist_panel", n, nb)
+    return panel
+
+
+def _make_upd_body(n: int, nb: int):
+    def upd(T, P, k, j):
+        k = int(k)
+        j = int(j)  # static under _static_values
+        j0 = j * nb
+        Pj = P[j0:j0 + nb, :]           # panel rows of block j's columns
+        return T - jnp.matmul(P, Pj.T)  # full-height: junk rows are upper
+
+    upd._static_values = True
+    upd._jit_key = ("segchol_dist_upd", n, nb)
+    return upd
+
+
+def dist_segmented_cholesky_ptg(n: int, nb: int) -> PTG:
+    """Build the distributed segmented dpotrf PTG.  Instantiate with
+    ``.taskpool(NT=n//nb, C=collection, TILE_SHAPE=(n, nb))`` where
+    ``C(j)`` is the full-height column block j, distributed by the
+    collection's ``rank_of``."""
+    if n % nb:
+        raise ValueError(f"N={n} not divisible by nb={nb}")
+    ptg = PTG("dpotrf_seg_dist")
+    panel = ptg.task_class("panel", k="0 .. NT-1")
+    panel.affinity("C(k)")
+    panel.priority("2 * (NT - k)")  # panels ARE the critical path
+    panel.flow("M", INOUT,
+               "<- (k == 0) ? C(k) : T upd(k-1, k)",
+               "-> C(k)")
+    panel.flow("P", OUT,
+               "-> (k < NT-1) ? P upd(k, k+1 .. NT-1)")
+    panel.body(tpu=_make_panel_body(n, nb))
+
+    upd = ptg.task_class("upd", k="0 .. NT-2", j="k+1 .. NT-1")
+    upd.affinity("C(j)")
+    upd.priority("NT - k")
+    upd.flow("T", INOUT,
+             "<- (k == 0) ? C(j) : T upd(k-1, j)",
+             "-> (j == k+1) ? M panel(j) : T upd(k+1, j)")
+    upd.flow("P", IN, "<- P panel(k)")
+    upd.body(tpu=_make_upd_body(n, nb))
+    return ptg
+
+
+def run_dist_segmented_cholesky(nranks: int, n: int, nb: int, *,
+                                fabric=None, nb_cores: int = 2,
+                                timeout: float = 300,
+                                seed: int = 7,
+                                dtype=np.float32,
+                                trace_pins: bool = False):
+    """Drive the distributed segmented dpotrf over ``nranks`` inproc
+    ranks (one Context + TpuDevice per rank, rank r on local device r) —
+    the multi-rank north-star artifact for dryrun/tests.  Returns
+    ``(err, stats_dict)``; with ``trace_pins`` the comm/compute overlap
+    fraction from the native binary tracer is included."""
+    import threading
+
+    from .. import Context
+    from ..comm.inproc import InprocFabric
+    from ..data import LocalCollection
+
+    rng = np.random.default_rng(seed)
+    m = rng.standard_normal((n, n)).astype(dtype)
+    SPD = m @ m.T + n * np.eye(n, dtype=dtype)
+    NT = n // nb
+
+    prof = None
+    subs = []
+    if trace_pins:
+        from ..profiling import pins
+        from ..profiling.binary import BinaryTaskProfiler
+
+        prof = BinaryTaskProfiler()
+        k_send = prof.trace.keyword("comm_send")
+        k_recv = prof.trace.keyword("comm_recv")
+        for site, cb in ((pins.COMM_ACTIVATE,
+                          lambda es, info: prof.trace.instant(k_send)),
+                         (pins.COMM_DATA_PLD,
+                          lambda es, info: prof.trace.instant(k_recv))):
+            pins.subscribe(site, cb)
+            subs.append((site, cb))
+
+    fabric = fabric or InprocFabric(nranks)
+    ces = fabric.endpoints()
+    ctxs = [Context(nb_cores=nb_cores, rank=r, nranks=nranks, comm=ces[r])
+            for r in range(nranks)]
+    cols, oks, errs = {}, [False] * nranks, []
+
+    def worker(r):
+        try:
+            dc = LocalCollection(
+                "C", shape=(n, nb), dtype=dtype, nodes=nranks, myrank=r,
+                init=lambda j: np.ascontiguousarray(
+                    SPD[:, j * nb:(j + 1) * nb]))
+            dc.rank_of = lambda j: j % nranks
+            cols[r] = dc
+            tp = dist_segmented_cholesky_ptg(n, nb).taskpool(
+                NT=NT, C=dc, TILE_SHAPE=(n, nb), TILE_DTYPE=dtype)
+            ctxs[r].add_taskpool(tp)
+            oks[r] = tp.wait(timeout=timeout)
+        except Exception as e:  # surfaced by the caller
+            errs.append((r, e))
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(nranks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout + 30)
+
+    stats: dict = {}
+    try:
+        if errs:
+            raise RuntimeError(f"rank errors: {errs}")
+        if not all(oks):
+            raise RuntimeError(f"ranks failed to quiesce: {oks}")
+        out = np.zeros((n, n), dtype)
+        execd = 0
+        d2d = 0
+        for r, dc in cols.items():
+            dev = next(d for d in ctxs[r].devices if d.mca_name == "tpu")
+            execd += dev.stats["executed_tasks"]
+            d2d += dev.stats["bytes_d2d"]
+            for j in range(NT):
+                if j % nranks != r:
+                    continue
+                c = dc.data_of(j).newest_copy()
+                out[:, j * nb:(j + 1) * nb] = np.asarray(c.payload)
+        stats["executed_tasks"] = execd
+        stats["bytes_d2d"] = d2d
+        stats["activations"] = sum(
+            c.comm.remote_dep.stats["activations_sent"] for c in ctxs)
+        ref = np.linalg.cholesky(SPD.astype(np.float64))
+        err = float(np.abs(np.tril(out).astype(np.float64) - ref).max()
+                    / np.abs(ref).max())
+    finally:
+        for c in ctxs:
+            c.fini()
+        if prof is not None:
+            from ..profiling import pins
+
+            for site, cb in subs:
+                pins.unsubscribe(site, cb)
+            prof.uninstall()
+
+    if prof is not None:
+        import os
+        import tempfile
+
+        from ..profiling.binary import to_chrome_events
+        from ..profiling.tools import comm_overlap_fraction
+
+        fd, path = tempfile.mkstemp(suffix=".pbt")
+        os.close(fd)
+        try:
+            prof.trace.dump(path)
+            frac, n_comm, busy_us = comm_overlap_fraction(
+                to_chrome_events(path))
+            stats["overlap_fraction"] = frac
+            stats["n_comm_events"] = n_comm
+            stats["busy_us"] = busy_us
+        finally:
+            os.unlink(path)
+    return err, stats

@@ -49,36 +49,98 @@ except Exception:  # pragma: no cover
 INOUT = AccessMode.INOUT
 
 
-def _make_lu_body(n: int, nb: int, strip: int, prec, kt: int):
+def _pivoted_panel(A, k0: int, nb: int):
+    """Right-looking getf2 with PARTIAL PIVOTING over the full trailing
+    column height: ``A`` is the (n, nb) full-height column block, valid
+    rows ``>= k0``.  Returns the packed L\\U block (rows >= k0; unit L
+    below the diagonal, U on/above) and the GLOBAL row permutation
+    applied (identity above k0).  nb sequential rank-1 steps — VPU-bound
+    but only n x nb work per panel; the O(n^3) trailing update stays on
+    the MXU."""
+    n = A.shape[0]
+    rows = jnp.arange(n)
+    cols = jnp.arange(nb)
+
+    def bstep(i, carry):
+        A, perm = carry
+        ri = k0 + i
+        col = A[:, i]
+        p = jnp.argmax(jnp.where(rows >= ri, jnp.abs(col), -jnp.inf))
+        # swap rows ri <-> p (A and the permutation record)
+        Ari, Ap = A[ri], A[p]
+        A = A.at[ri].set(Ap).at[p].set(Ari)
+        pi, pp = perm[ri], perm[p]
+        perm = perm.at[ri].set(pp).at[p].set(pi)
+        piv = A[ri, i]
+        f = jnp.where(rows > ri, A[:, i] / piv, 0.0)
+        # eliminate: rows > ri, columns > i; store multipliers in col i
+        A = A - jnp.outer(f, A[ri]) * (cols > i)[None, :]
+        A = A.at[:, i].set(jnp.where(rows > ri, f, A[:, i]))
+        return A, perm
+
+    return lax.fori_loop(0, nb, bstep, (A, jnp.arange(n)))
+
+
+def _make_lu_body(n: int, nb: int, strip: int, prec, kt: int, bf16=False,
+                  pivot: str = "block"):
+    """``bf16`` mirrors the cholesky levers (ops/segmented_chol.py):
+    False = f32 3-pass trailing update; True = bf16 OPERANDS into the
+    trailing gemm with f32 accumulation (ONE MXU pass instead of three —
+    the update is ~all the flops); ``"storage"`` = the matrix itself
+    lives in bf16 (panel math upcast to f32) — HALF the HBM traffic.
+
+    ``pivot="panel"`` replaces the block-local factorization with TRUE
+    partial pivoting over the full trailing column height (LAPACK getrf
+    blocked shape): the per-panel permutation is applied to ALL columns
+    and composed into the threaded pivot vector.  Costs the getf2
+    scalar chain (VPU) plus an O(n x n) row gather per panel."""
+    store_bf16 = bf16 == "storage"
+    if pivot == "panel":
+        return _make_lu_body_panelpiv(n, nb, strip, prec, kt, bf16)
+
     def step(M, k):
         k0 = k * nb
-        f32 = M.dtype
+        f32 = jnp.float32 if store_bf16 else M.dtype
         hi = Precision.HIGHEST
         eye = jnp.eye(nb, dtype=f32)
-        D = M[k0:k0 + nb, k0:k0 + nb]
+        D = M[k0:k0 + nb, k0:k0 + nb].astype(f32)
         P_, L_D, U_D = jax.scipy.linalg.lu(D)
         # block-local row swaps across ALL columns (a permutation matmul
         # is exact in any precision and rides the MXU)
         rows = M[k0:k0 + nb, :]
         M = M.at[k0:k0 + nb, :].set(
-            jnp.matmul(P_.T, rows, precision=Precision.DEFAULT))
+            jnp.matmul(P_.T.astype(M.dtype), rows,
+                       precision=Precision.DEFAULT))
         invU = lax.linalg.triangular_solve(U_D, eye, lower=False,
                                            left_side=True)
         invL = lax.linalg.triangular_solve(L_D, eye, lower=True,
                                            left_side=True)
         M = M.at[k0:k0 + nb, k0:k0 + nb].set(
-            jnp.triu(U_D) + jnp.tril(L_D, -1))
+            (jnp.triu(U_D) + jnp.tril(L_D, -1)).astype(M.dtype))
         if k0 + nb >= n:
             return M
-        Lp = jnp.matmul(M[k0 + nb:, k0:k0 + nb], invU, precision=hi)
-        Ur = jnp.matmul(invL, M[k0:k0 + nb, k0 + nb:], precision=hi)
-        M = M.at[k0 + nb:, k0:k0 + nb].set(Lp)
-        M = M.at[k0:k0 + nb, k0 + nb:].set(Ur)
+        Lp = jnp.matmul(M[k0 + nb:, k0:k0 + nb].astype(f32), invU,
+                        precision=hi)
+        Ur = jnp.matmul(invL, M[k0:k0 + nb, k0 + nb:].astype(f32),
+                        precision=hi)
+        M = M.at[k0 + nb:, k0:k0 + nb].set(Lp.astype(M.dtype))
+        M = M.at[k0:k0 + nb, k0 + nb:].set(Ur.astype(M.dtype))
+        if store_bf16 or bf16:
+            Lb, Ub = Lp.astype(jnp.bfloat16), Ur.astype(jnp.bfloat16)
         for c0 in range(k0 + nb, n, strip):
             w = min(strip, n - c0)
-            M = M.at[k0 + nb:, c0:c0 + w].add(
-                -jnp.matmul(Lp, Ur[:, c0 - k0 - nb:c0 - k0 - nb + w],
-                            precision=prec))
+            cs = slice(c0 - k0 - nb, c0 - k0 - nb + w)
+            if store_bf16:
+                upd = jnp.matmul(Lb, Ub[:, cs], preferred_element_type=f32)
+                M = M.at[k0 + nb:, c0:c0 + w].set(
+                    (M[k0 + nb:, c0:c0 + w].astype(f32) - upd
+                     ).astype(jnp.bfloat16))
+            elif bf16:
+                M = M.at[k0 + nb:, c0:c0 + w].add(
+                    -jnp.matmul(Lb, Ub[:, cs], preferred_element_type=f32))
+            else:
+                M = M.at[k0 + nb:, c0:c0 + w].add(
+                    -jnp.matmul(Lp, Ur[:, cs], precision=prec))
         return M
 
     def panel(M, k):
@@ -91,11 +153,61 @@ def _make_lu_body(n: int, nb: int, strip: int, prec, kt: int):
 
     panel._static_values = True
     panel._donate_args = (0,)
-    panel._jit_key = ("seglu_panel", n, nb, strip, str(prec), kt)
+    panel._jit_key = ("seglu_panel", n, nb, strip, str(prec), kt, str(bf16))
     return panel
 
 
-def _make_lu_body_generic(n: int, nb: int, strip: int, prec, kt: int):
+def _make_lu_body_panelpiv(n: int, nb: int, strip: int, prec, kt: int,
+                           bf16=False):
+    """Panel-wide partial pivoting variant (``pivot="panel"``): the
+    pivoted getf2 factors each full-height panel, its row permutation is
+    applied across ALL columns, and the composed permutation rides a
+    second INOUT flow (the pivot vector V: ``V[i]`` = original row index
+    now at row i, so ``A[V] = L @ U``).  f32 only for now."""
+    if bf16:
+        raise NotImplementedError(
+            "pivot='panel' currently supports f32 storage only")
+
+    def step(M, V, k):
+        k0 = k * nb
+        f32 = M.dtype
+        hi = Precision.HIGHEST
+        C, perm = _pivoted_panel(M[:, k0:k0 + nb], k0, nb)
+        # the panel's swaps apply to EVERY column and compose into V
+        M = M[perm]
+        V = V[perm]
+        M = M.at[:, k0:k0 + nb].set(C)
+        if k0 + nb >= n:
+            return M, V
+        L_D = jnp.tril(C[k0:k0 + nb], -1) + jnp.eye(nb, dtype=f32)
+        invL = lax.linalg.triangular_solve(
+            L_D, jnp.eye(nb, dtype=f32), lower=True, left_side=True)
+        Ur = jnp.matmul(invL, M[k0:k0 + nb, k0 + nb:], precision=hi)
+        M = M.at[k0:k0 + nb, k0 + nb:].set(Ur)
+        Lp = C[k0 + nb:, :]  # the stored multipliers ARE the L panel
+        for c0 in range(k0 + nb, n, strip):
+            w = min(strip, n - c0)
+            M = M.at[k0 + nb:, c0:c0 + w].add(
+                -jnp.matmul(Lp, Ur[:, c0 - k0 - nb:c0 - k0 - nb + w],
+                            precision=prec))
+        return M, V
+
+    def panel(M, V, k):
+        k = int(k)  # static under _static_values
+        if k < kt:
+            return step(M, V, k)
+        for kk in range(kt, n // nb):  # fused tail: one program
+            M, V = step(M, V, kk)
+        return M, V
+
+    panel._static_values = True
+    panel._donate_args = (0, 1)
+    panel._jit_key = ("seglu_panel_pp", n, nb, strip, str(prec), kt)
+    return panel
+
+
+def _make_lu_body_generic(n: int, nb: int, strip: int, prec, kt: int,
+                          bf16=False):
     """Parameter-generic getrf panel body: ONE compiled program for every
     k (traced scalar + ``lax.dynamic_slice``; round-3 VERDICT #3).
 
@@ -114,47 +226,64 @@ def _make_lu_body_generic(n: int, nb: int, strip: int, prec, kt: int):
     3.5 s compile vs static 13.8 TF / 18.4 s — 94% of static throughput
     at 5x faster compile, hence the default."""
     nt = n // nb
+    store_bf16 = bf16 == "storage"
 
     def step(k, M):
         k0 = k * nb
-        f32 = M.dtype
+        f32 = jnp.float32 if store_bf16 else M.dtype
         hi = Precision.HIGHEST
         eye = jnp.eye(nb, dtype=f32)
-        D = lax.dynamic_slice(M, (k0, k0), (nb, nb))
+        D = lax.dynamic_slice(M, (k0, k0), (nb, nb)).astype(f32)
         P_, L_D, U_D = jax.scipy.linalg.lu(D)
         # block-local row swaps across ALL columns (a permutation matmul
         # is exact in any precision and rides the MXU)
         rows = lax.dynamic_slice(M, (k0, 0), (nb, n))
-        rows = jnp.matmul(P_.T, rows, precision=Precision.DEFAULT)
+        rows = jnp.matmul(P_.T.astype(M.dtype), rows,
+                          precision=Precision.DEFAULT)
         M = lax.dynamic_update_slice(M, rows, (k0, 0))
         invU = lax.linalg.triangular_solve(U_D, eye, lower=False,
                                            left_side=True)
         invL = lax.linalg.triangular_solve(L_D, eye, lower=True,
                                            left_side=True)
         M = lax.dynamic_update_slice(
-            M, jnp.triu(U_D) + jnp.tril(L_D, -1), (k0, k0))
+            M, (jnp.triu(U_D) + jnp.tril(L_D, -1)).astype(M.dtype),
+            (k0, k0))
         # full-extent solves; only the [k0+nb, n) part is ever stored
-        C = lax.dynamic_slice(M, (0, k0), (n, nb))    # full column
+        C = lax.dynamic_slice(M, (0, k0), (n, nb)).astype(f32)
         Lp = jnp.matmul(C, invU, precision=hi)        # rows >= k0+nb valid
-        Rw = lax.dynamic_slice(M, (k0, 0), (nb, n))   # full row slab
+        Rw = lax.dynamic_slice(M, (k0, 0), (nb, n)).astype(f32)
         Ur = jnp.matmul(invL, Rw, precision=hi)       # cols >= k0+nb valid
+        if store_bf16 or bf16:
+            Lb, Ub = Lp.astype(jnp.bfloat16), Ur.astype(jnp.bfloat16)
 
         def put_col(r0, h, M):  # store L panel rows [r0, r0+h)
             return lax.dynamic_update_slice(
-                M, lax.dynamic_slice(Lp, (r0, 0), (h, nb)), (r0, k0))
+                M, lax.dynamic_slice(Lp, (r0, 0), (h, nb)).astype(M.dtype),
+                (r0, k0))
 
         def put_row(c0, w, M):  # store U row columns [c0, c0+w)
             return lax.dynamic_update_slice(
-                M, lax.dynamic_slice(Ur, (0, c0), (nb, w)), (k0, c0))
+                M, lax.dynamic_slice(Ur, (0, c0), (nb, w)).astype(M.dtype),
+                (k0, c0))
 
         M = _chunked(k, n, nb, strip, put_col, M)
         M = _chunked(k, n, nb, strip, put_row, M)
 
         def upd(r0, h, c0, w, M):
-            Li = lax.dynamic_slice(Lp, (r0, 0), (h, nb))
-            Uj = lax.dynamic_slice(Ur, (0, c0), (nb, w))
             T = lax.dynamic_slice(M, (r0, c0), (h, w))
-            T = T - jnp.matmul(Li, Uj, precision=prec)
+            if store_bf16:
+                Li = lax.dynamic_slice(Lb, (r0, 0), (h, nb))
+                Uj = lax.dynamic_slice(Ub, (0, c0), (nb, w))
+                u = jnp.matmul(Li, Uj, preferred_element_type=f32)
+                T = (T.astype(f32) - u).astype(jnp.bfloat16)
+            elif bf16:
+                Li = lax.dynamic_slice(Lb, (r0, 0), (h, nb))
+                Uj = lax.dynamic_slice(Ub, (0, c0), (nb, w))
+                T = T - jnp.matmul(Li, Uj, preferred_element_type=f32)
+            else:
+                Li = lax.dynamic_slice(Lp, (r0, 0), (h, nb))
+                Uj = lax.dynamic_slice(Ur, (0, c0), (nb, w))
+                T = T - jnp.matmul(Li, Uj, precision=prec)
             return lax.dynamic_update_slice(M, T, (r0, c0))
 
         def cols(c0, w, M):
@@ -169,18 +298,34 @@ def _make_lu_body_generic(n: int, nb: int, strip: int, prec, kt: int):
         return lax.fori_loop(k, kend, step, M)
 
     panel._donate_args = (0,)
-    panel._jit_key = ("seglu_panel_g", n, nb, strip, str(prec), kt)
+    panel._jit_key = ("seglu_panel_g", n, nb, strip, str(prec), kt,
+                      str(bf16))
     return panel
 
 
 def segmented_lu_ptg(n: int, nb: int, *, strip: int = 4096,
                      prec=None, tail: int = 4096,
-                     specialize: str = "generic") -> PTG:
+                     specialize: str = "generic", bf16=False,
+                     pivot: str = "block") -> PTG:
     """Build the segmented getrf PTG (factors in place: unit-lower L
     below the diagonal, U on/above).  Instantiate with
     ``.taskpool(NT=n_segments(n, nb, tail), A=collection)``.
     ``specialize="generic"`` (default) compiles one parameter-generic
-    program; ``"static"`` bakes k per task (O(NT) programs)."""
+    program; ``"static"`` bakes k per task (O(NT) programs).
+
+    ``bf16``: False = f32 trailing update at ``prec`` (3-pass MXU);
+    True = bf16 OPERANDS with f32 accumulation (one MXU pass — the
+    trailing gemm is ~all the flops); ``"storage"`` = the whole matrix
+    lives in bf16 (panel math upcast to f32), HALF the HBM traffic.
+    bf16-class numerics (~1e-3 on off-diagonal entries) — callers gate
+    at the 1e-2 bf16 bar and label fields accordingly (bench.py).
+
+    ``pivot``: ``"block"`` (default) = NOPIV-CLASS mode — the pivot
+    search is restricted to the nb diagonal rows; exact for the
+    diagonally-dominant inputs nopiv targets.  ``"panel"`` = true
+    partial pivoting over the full trailing column height (static
+    specialization, f32 only); adds a pivot-vector flow (``PV``
+    collection) so ``A[V] = L @ U``."""
     if n % nb:
         raise ValueError(f"N={n} not divisible by nb={nb}")
     strip = min(strip, n)
@@ -196,9 +341,20 @@ def segmented_lu_ptg(n: int, nb: int, *, strip: int = 4096,
     panel.flow("M", INOUT,
                "<- (k == 0) ? A(0) : M panel(k-1)",
                "-> (k == NT-1) ? A(0) : M panel(k+1)")
+    if pivot == "panel":
+        if specialize != "static":
+            raise ValueError("pivot='panel' requires specialize='static'")
+        panel.flow("V", INOUT,
+                   "<- (k == 0) ? PV(0) : V panel(k-1)",
+                   "-> (k == NT-1) ? PV(0) : V panel(k+1)")
+        panel.body(tpu=_make_lu_body_panelpiv(n, nb, strip, prec, kt,
+                                              bf16=bf16))
+        return ptg
+    if pivot != "block":
+        raise ValueError(f"unknown pivot mode {pivot!r}")
     make = (_make_lu_body_generic if specialize == "generic"
             else _make_lu_body)
-    panel.body(tpu=make(n, nb, strip, prec, kt))
+    panel.body(tpu=make(n, nb, strip, prec, kt, bf16=bf16))
     return ptg
 
 
@@ -207,21 +363,37 @@ class SegmentedLU:
     taskpool + scheduler + TPU device module."""
 
     def __init__(self, context, n: int, nb: int, *, strip: int = 4096,
-                 prec=None, tail: int = 4096, specialize: str = "generic"):
+                 prec=None, tail: int = 4096, specialize: str = "generic",
+                 bf16=False, pivot: str = "block"):
         self.context = context
         self.n, self.nb = n, nb
+        self.store_bf16 = bf16 == "storage"
+        self.pivot = pivot
         self.nt_tasks = n_segments(n, nb, tail)
         self.ptg = segmented_lu_ptg(n, nb, strip=strip, prec=prec,
-                                    tail=tail, specialize=specialize)
+                                    tail=tail, specialize=specialize,
+                                    bf16=bf16, pivot=pivot)
         self.device = next(
             (d for d in context.devices if d.mca_name == "tpu"), None)
         if self.device is None:
             raise RuntimeError("segmented LU needs the tpu device module")
 
     def run(self, A_dev, *, timeout: Optional[float] = 600):
-        """Factorize in place (donated); returns the packed L\\U array."""
+        """Factorize in place (donated); returns the packed L\\U array —
+        or ``(LU, V)`` in panel-pivot mode, where row i of LU is original
+        row ``V[i]`` (``A[V] = L @ U``).  In storage mode the input must
+        arrive (or is cast) bf16."""
+        if self.store_bf16 and A_dev.dtype != jnp.bfloat16:
+            A_dev = A_dev.astype(jnp.bfloat16)
         d = _attach_device_matrix(self.device, "A", A_dev)
-        tp = self.ptg.taskpool(NT=self.nt_tasks, A=d.collection)
+        kwargs = {"NT": self.nt_tasks, "A": d.collection}
+        dv = None
+        if self.pivot == "panel":
+            V0 = jax.device_put(jnp.arange(self.n, dtype=jnp.int32),
+                                self.device.jdev)
+            dv = _attach_device_matrix(self.device, "PV", V0)
+            kwargs["PV"] = dv.collection
+        tp = self.ptg.taskpool(**kwargs)
         self.context.add_taskpool(tp)
         if not tp.wait(timeout=timeout):
             raise RuntimeError("segmented LU did not quiesce")
@@ -230,11 +402,21 @@ class SegmentedLU:
             raise RuntimeError("segmented LU left no device result")
         payload = c.payload
         self.device.drop_residency(d)
+        if dv is not None:
+            cv = dv.get_copy(self.device.data_index)
+            self.device.drop_residency(dv)
+            return payload, cv.payload
         return payload
 
     def __call__(self, A_np: np.ndarray):
         A = jax.device_put(jnp.asarray(np.ascontiguousarray(A_np)),
                            self.device.jdev)
-        M = np.asarray(jax.device_get(self.run(A)))
+        out = self.run(A)
+        if self.pivot == "panel":
+            M = np.asarray(jax.device_get(out[0]))
+            V = np.asarray(jax.device_get(out[1]))
+            L = np.tril(M, -1) + np.eye(self.n, dtype=M.dtype)
+            return L, np.triu(M), V
+        M = np.asarray(jax.device_get(out))
         L = np.tril(M, -1) + np.eye(self.n, dtype=M.dtype)
         return L, np.triu(M)

@@ -1,0 +1,111 @@
+"""Band distributions + diag_band_to_rect (reference
+``{sym_,}two_dim_rectangle_cyclic_band.{c,h}`` and
+``data_dist/matrix/diag_band_to_rect.jdf``)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.comm import InprocFabric
+from parsec_tpu.datadist import (
+    SymTwoDimBlockCyclicBand,
+    TiledMatrix,
+    TwoDimBlockCyclic,
+    TwoDimBlockCyclicBand,
+)
+from parsec_tpu.datadist.band import (
+    diag_band_to_rect_ptg,
+    diag_band_to_rect_reference,
+)
+
+
+def test_band_distribution_routing():
+    """Band tiles route to the band sub-distribution with the remapped
+    row; off-band tiles to the off-band distribution; data_of storage
+    lives in the sub-collections."""
+    nodes, bs = 4, 2
+    band = TwoDimBlockCyclic(3 * 16, 8 * 16, 16, 16, p=1, q=nodes,
+                             myrank=0, name="band")
+    off = TwoDimBlockCyclic(8 * 16, 8 * 16, 16, 16, p=2, q=2,
+                            myrank=0, name="off")
+    dc = TwoDimBlockCyclicBand(band, off, bs)
+    for i in range(8):
+        for j in range(8):
+            if abs(i - j) < bs:
+                assert dc.rank_of(i, j) == band.rank_of(i - j + bs - 1, j)
+                assert dc.data_of(i, j) is band.data_of(i - j + bs - 1, j)
+            else:
+                assert dc.rank_of(i, j) == off.rank_of(i, j)
+                assert dc.data_of(i, j) is off.data_of(i, j)
+    # symmetric variant: |i-j| row remap
+    sband = TwoDimBlockCyclic(bs * 16, 8 * 16, 16, 16, p=1, q=nodes,
+                              myrank=0, name="sband")
+    sdc = SymTwoDimBlockCyclicBand(sband, off, bs)
+    assert sdc.rank_of(5, 4) == sband.rank_of(1, 4)
+    assert sdc.rank_of(4, 5) == sband.rank_of(1, 5)
+    assert sdc.rank_of(6, 2) == off.rank_of(6, 2)
+
+
+def test_diag_band_to_rect_single_rank():
+    MB = NB = 8
+    NT = 4
+    rng = np.random.default_rng(3)
+    Afull = rng.standard_normal((NT * MB, NT * NB))
+    A = TiledMatrix(NT * MB, NT * NB, MB, NB, name="A").from_array(Afull)
+    B = TiledMatrix(MB + 1, NT * (NB + 2), MB + 1, NB + 2, name="B")
+    ctx = Context(nb_cores=2)
+    try:
+        tp = diag_band_to_rect_ptg(MB, NB).taskpool(NT=NT, A=A, B=B)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=60)
+    finally:
+        ctx.fini()
+    got = B.to_array()
+    ref = diag_band_to_rect_reference(Afull, MB, NB, NT)
+    np.testing.assert_allclose(got, ref)
+
+
+def test_diag_band_to_rect_multirank():
+    """A's diag/subdiag tiles and B's band tiles live on DIFFERENT rank
+    layouts: the readers forward tiles over the activation wire."""
+    nranks, MB, NB, NT = 2, 8, 8, 4
+    rng = np.random.default_rng(4)
+    Afull = rng.standard_normal((NT * MB, NT * NB))
+    fabric = InprocFabric(nranks)
+    ces = fabric.endpoints()
+    ctxs = [Context(nb_cores=2, rank=r, nranks=nranks, comm=ces[r])
+            for r in range(nranks)]
+    bmats, oks = {}, [False] * nranks
+
+    def worker(r):
+        A = TwoDimBlockCyclic(NT * MB, NT * NB, MB, NB, p=nranks, q=1,
+                              myrank=r, name="A").from_array(Afull)
+        B = TwoDimBlockCyclic(MB + 1, NT * (NB + 2), MB + 1, NB + 2,
+                              p=1, q=nranks, myrank=r,
+                              name="B").from_array(
+                                  np.zeros((MB + 1, NT * (NB + 2))))
+        bmats[r] = B
+        tp = diag_band_to_rect_ptg(MB, NB).taskpool(NT=NT, A=A, B=B)
+        ctxs[r].add_taskpool(tp)
+        oks[r] = tp.wait(timeout=60)
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(nranks)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=90)
+    assert all(oks), oks
+    out = np.zeros((MB + 1, NT * (NB + 2)))
+    for r, B in bmats.items():
+        for (i, j) in B.tiles():
+            if B.rank_of(i, j) != r:
+                continue
+            c = B.data_of(i, j).newest_copy()
+            h, w = B.tile_shape(i, j)
+            out[:h, j * (NB + 2):j * (NB + 2) + w] = np.asarray(c.payload)
+    for c in ctxs:
+        c.fini()
+    np.testing.assert_allclose(
+        out, diag_band_to_rect_reference(Afull, MB, NB, NT))

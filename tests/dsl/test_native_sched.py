@@ -118,3 +118,49 @@ def test_python_bodies_still_correct_lfq():
     seen = []
     g.run(lambda tid, tag: seen.append(tag), nthreads=4)
     assert sorted(seen) == list(range(200))
+
+
+def test_hierarchical_steal_vpmap():
+    """2-level steal: with a vpmap, victims in the SAME VP are tried
+    before crossing domains.  Deterministic pins: one-VP-per-worker
+    forces every steal cross-VP; all-one-VP forces every steal local."""
+    import numpy as np
+
+    from parsec_tpu import native
+
+    if not native.available():
+        import pytest
+
+        pytest.skip(f"native core unavailable: {native.build_error()}")
+
+    def run_fan(vpmap):
+        ng = native.NativeGraph()
+        # a root fanning out to many tiny tasks: the completing worker
+        # keeps one and floods its local heap; others must steal
+        root = ng.add_task(priority=0, user_tag=0)
+        for _ in range(200):
+            t = ng.add_task(priority=0, user_tag=0)
+            ng.add_dep(root, t)
+        for tid in range(201):
+            ng.commit(tid)
+        ng.seal()
+        if vpmap is not None:
+            ng.set_vpmap(vpmap)
+        done = []
+
+        def body(tid, tag):
+            x = 0.0
+            for i in range(200):
+                x += i * 1.0
+            done.append(tid)
+
+        n = ng.run(body, nthreads=4)
+        assert n == 201
+        return ng.steals, ng.steals_remote
+
+    s, r = run_fan([0, 0, 0, 0])  # one VP: nothing is ever cross-VP
+    assert r == 0
+    s2, r2 = run_fan([0, 1, 2, 3])  # one worker per VP: all steals cross
+    assert s2 == r2
+    s3, r3 = run_fan(None)  # flat (no vpmap): remote counter unused
+    assert r3 == 0

@@ -130,3 +130,107 @@ END
     for k in range(2):
         got = stage_to_cpu(dc.data_of(k))
         np.testing.assert_allclose(got, [1.0, 1.0, 0.0, 0.0])
+
+
+def test_packed_copy_never_served_as_home_layout(ctx):
+    """A READ flow's pack hook leaves a PACKED device copy; a later
+    hookless task on the same tile must NOT receive it — the default
+    stage-in drops the packed copy and restages the home layout."""
+    dev = tpu_dev(ctx)
+    N = 8
+    d_ = None
+    from parsec_tpu.data import data_create
+
+    base = np.arange(float(N * N)).reshape(N, N)
+    d_ = data_create("pk", payload=base.copy())
+    seen_shapes = []
+
+    def pack(data, device):
+        return jnp.asarray(np.asarray(data.newest_copy().payload)[:, ::2])
+
+    from parsec_tpu.dsl.ptg import IN, PTG
+
+    ptg = PTG("pkread")
+    t = ptg.task_class("t", k="0 .. 0")
+    t.affinity("A(0)")
+    t.flow("X", IN, "<- A(0)")
+    t.stage("X", stage_in=pack)  # READ-only: no stage_out needed
+    t.body(tpu=lambda X, k: (seen_shapes.append(X.shape), ())[1])
+    from parsec_tpu.data import LocalCollection
+
+    dc = LocalCollection("A", shape=(N, N), init=lambda k: base.copy())
+    tp = ptg.taskpool(A=dc)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=60)
+    assert seen_shapes == [(N, N // 2)]  # the body saw the packed tile
+    # now a plain device task on the same tile: must see FULL layout
+    from parsec_tpu.dsl import DTDTaskpool, INOUT
+
+    tp2 = DTDTaskpool(ctx)
+    tp2.insert_task({"tpu": lambda x: x + 1.0}, (dc.data_of(0), INOUT))
+    assert tp2.wait(timeout=60)
+    from parsec_tpu.dsl.dtd import stage_to_cpu
+
+    np.testing.assert_allclose(stage_to_cpu(dc.data_of(0)), base + 1.0)
+
+
+def test_custom_staging_preserves_dirty_device_copy(ctx):
+    """A dirty (device-only) newest version must be flushed home BEFORE
+    a pack hook replaces the device copy — otherwise the unpacked part
+    of the newest data exists nowhere and the scatter hook reconstructs
+    from stale host values."""
+    tpu_dev(ctx)
+    N = 8
+    from parsec_tpu.data import LocalCollection
+    from parsec_tpu.dsl.ptg import INOUT, PTG
+
+    base = np.zeros((N, N))
+    dc = LocalCollection("A", shape=(N, N), init=lambda k: base.copy())
+
+    def pack(data, device):
+        return jnp.asarray(np.asarray(data.get_copy(0).payload)[:, ::2])
+
+    def scatter(arr, data, device):
+        full = jnp.asarray(np.asarray(data.get_copy(0).payload))
+        return full.at[:, ::2].set(arr)
+
+    ptg = PTG("dirtypack")
+    # t1: plain device body makes the device copy the ONLY newest
+    # version (+5 everywhere); t2: pack/scatter hooks on even columns
+    t1 = ptg.task_class("t1", k="0 .. 0")
+    t1.affinity("A(0)")
+    t1.flow("X", INOUT, "<- A(0)", "-> X t2(0)")
+    t1.body(tpu=lambda X, k: X + 5.0)
+    t2 = ptg.task_class("t2", k="0 .. 0")
+    t2.affinity("A(0)")
+    t2.flow("X", INOUT, "<- X t1(0)", "-> A(0)")
+    t2.stage("X", stage_in=pack, stage_out=scatter)
+    t2.body(tpu=lambda X, k: X * 2.0)
+    tp = ptg.taskpool(A=dc)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=60)
+    from parsec_tpu.dsl.dtd import stage_to_cpu
+
+    got = stage_to_cpu(dc.data_of(0))
+    expect = np.full((N, N), 5.0)
+    expect[:, ::2] = 10.0
+    np.testing.assert_allclose(got, expect)  # odd columns kept t1's +5
+
+
+def test_stage_in_writable_without_stage_out_fails_loudly(ctx):
+    """stage_in on a writable flow with no stage_out would commit the
+    packed body output as the home tile: refused, pool fails."""
+    tpu_dev(ctx)
+    from parsec_tpu.data import LocalCollection
+    from parsec_tpu.dsl.ptg import INOUT, PTG
+
+    dc = LocalCollection("A", shape=(4,), init=lambda k: np.zeros(4))
+    ptg = PTG("badstage")
+    t = ptg.task_class("t", k="0 .. 0")
+    t.affinity("A(0)")
+    t.flow("X", INOUT, "<- A(0)", "-> A(0)")
+    t.stage("X", stage_in=lambda data, device: jnp.zeros(2))
+    t.body(tpu=lambda X, k: X)
+    tp = ptg.taskpool(A=dc)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=60) is False  # loud failure, not silent corruption
