@@ -127,10 +127,15 @@ def capture(tp: PTGTaskpool, ranks: Optional[Iterable[int]] = None) -> TaskGraph
     consts = tp.constants
     rankset = set(ranks) if ranks is not None else None
 
-    # pass 1: nodes
+    # pass 1: nodes — also record the GLOBAL placement map (every valid
+    # task's rank), which distributed consumers (native_dist's remote-
+    # edge planner) would otherwise re-derive with a second full
+    # param-space scan
+    g.global_ranks = {}
     for pc in tp.ptg.classes.values():
         for loc in pc.param_space(consts):
             rank = pc.rank_of(loc, consts)
+            g.global_ranks[(pc.name, loc)] = rank
             if rankset is not None and rank not in rankset:
                 continue
             tid = (pc.name, loc)
@@ -165,8 +170,8 @@ def capture(tp: PTGTaskpool, ranks: Optional[Iterable[int]] = None) -> TaskGraph
                 for locs in _expand_args(t.args, env):
                     if len(locs) != len(succ_pc.param_names):
                         continue
-                    if not succ_pc.valid(locs, consts):
-                        continue
+                    # membership in g.nodes subsumes valid(): pass 1
+                    # built the node set FROM the class param spaces
                     stid = (t.class_name, locs)
                     if stid in g.nodes:
                         node.out_edges.append((f.name, stid, t.flow_name))
@@ -187,24 +192,43 @@ def source_tile(g: TaskGraph, tid: TaskId, flow_name: str):
     Returns ``("data", collection_name, key)`` or ``("new", producer_tid,
     flow)`` — the identity that aliases across the producer/consumer chain
     (PTG flows thread one datum through in-place bodies).
+
+    Memoized with path compression on the graph (long dpotrf-style
+    chains are walked once, not once per consumer); callers resolve
+    sources only AFTER capture completes, so the memo never observes a
+    half-built graph.
     """
+    memo = g.__dict__.setdefault("_src_memo", {})
+    key = (tid, flow_name)
+    hit = memo.get(key)
+    if hit is not None:
+        return hit
     seen = set()
+    path = []
     cur, cflow = tid, flow_name
     while True:
         if (cur, cflow) in seen:
             raise RuntimeError(f"cyclic flow chain at {cur}/{cflow}")
         seen.add((cur, cflow))
+        path.append((cur, cflow))
+        hit = memo.get((cur, cflow))
+        if hit is not None:
+            break
         src = g.nodes[cur].flow_sources.get(cflow)
-        if src is None:
-            return ("new", cur, cflow)
+        if src is None or src[0] == "new":
+            hit = ("new", cur, cflow)
+            break
         if src[0] == "data":
-            return src
-        if src[0] == "new":
-            return ("new", cur, cflow)
+            hit = src
+            break
         _, ptid, pflow = src
         if ptid not in g.nodes:
             # the chain leaves a rank-filtered capture: the flow's value
             # arrives from a REMOTE producer (native_dist resolves these
             # from deposited activation payloads)
-            return ("remote", ptid, pflow)
+            hit = ("remote", ptid, pflow)
+            break
         cur, cflow = ptid, pflow
+    for k in path:
+        memo[k] = hit
+    return hit

@@ -101,7 +101,11 @@ class NativeDistExecutor(NativeExecutor):
                 ng.add_dep(ph, self._index[ctid])
         # (b) remote OUTPUTS + cross-rank write-backs, from each local
         # node's dep targets (the same enumeration the Python
-        # release_deps path runs per completion, resolved once here)
+        # release_deps path runs per completion, resolved once here).
+        # Target ranks come from capture's global placement map — valid
+        # targets are exactly its keys, so no valid()/rank_of() re-eval
+        # on this hot path (construction cost IS the native-dist gap)
+        global_ranks = g.global_ranks
         for tid, node in g.nodes.items():
             pc = tp.ptg.classes[tid[0]]
             env = pc.env_of(tid[1], consts)
@@ -121,15 +125,10 @@ class NativeDistExecutor(NativeExecutor):
                             self._remote_wb.setdefault(tid, []).append(
                                 (t.collection_name, tuple(key), src, owner))
                         continue
-                    succ_pc = tp.ptg.classes[t.class_name]
                     for locs in _expand_args(t.args, env):
-                        if len(locs) != len(succ_pc.param_names):
-                            continue
-                        if not succ_pc.valid(locs, consts):
-                            continue
-                        r = succ_pc.rank_of(locs, consts)
-                        if r == self.rank:
-                            continue
+                        r = global_ranks.get((t.class_name, locs))
+                        if r is None or r == self.rank:
+                            continue  # invalid target or local successor
                         rank_masks[r] = rank_masks.get(r, 0) | (1 << f.index)
                         if f.mode != CTL and f.index not in payload_src:
                             payload_src[f.index] = source_tile(g, tid, f.name)
@@ -138,7 +137,9 @@ class NativeDistExecutor(NativeExecutor):
         # (c) write-backs EXPECTED here: remote tasks whose data-ref deps
         # land on tiles this rank owns (the Python runtime pre-counts
         # these as termdet runtime actions; phantoms are their native
-        # form — the run cannot quiesce before the data arrives)
+        # form — the run cannot quiesce before the data arrives).
+        # Placement reuses the capture map instead of a second full
+        # param-space + rank_of scan.
         for pc in tp.ptg.classes.values():
             wb_deps = [
                 (f, dep)
@@ -149,8 +150,8 @@ class NativeDistExecutor(NativeExecutor):
             ]
             if not wb_deps:
                 continue
-            for loc in pc.param_space(consts):
-                if pc.rank_of(loc, consts) == self.rank:
+            for (cname, loc), r in global_ranks.items():
+                if cname != pc.name or r == self.rank:
                     continue
                 env = pc.env_of(loc, consts)
                 for _f, dep in wb_deps:
@@ -164,6 +165,11 @@ class NativeDistExecutor(NativeExecutor):
                                 (t.collection_name, key), []).append(ph)
         self._n_phantoms = len(self._phantoms) + sum(
             len(v) for v in self._wb_phantoms.values())
+        # snapshots for rebind(): runs consume the live maps (pops on
+        # arrival / failure drain); a reuse run restores them
+        self._phantoms_init = dict(self._phantoms)
+        self._wb_phantoms_init = {k: list(v)
+                                  for k, v in self._wb_phantoms.items()}
         # every edge (local AND phantom) is declared: arm the local tasks
         # (phantom commit tokens stay with the network)
         for tid in g.nodes:
@@ -299,6 +305,27 @@ class NativeDistExecutor(NativeExecutor):
         for ph in phantoms:
             self._ng.commit(ph)
         return True
+
+    def rebind(self, tp: PTGTaskpool) -> "NativeDistExecutor":
+        """Distributed reuse: re-aim at a SAME-SHAPE taskpool (see
+        :meth:`NativeExecutor.rebind`).  The wire identity carries a
+        GENERATION tag (``name@@N``, advanced identically on every rank
+        at each rebind), so a fast rank's round-N+1 activations arriving
+        at a rank still finishing round N simply PARK under the unknown
+        name and replay at that rank's own rebind — no barrier needed,
+        no silent duplicate-drop.  Restores the phantom commit tokens
+        (held by the network again) before re-registering."""
+        self._generation = getattr(self, "_generation", 0) + 1
+        self._remote_payloads.clear()
+        self._terminated = False
+        self.failed = False
+        self._phantoms = dict(self._phantoms_init)
+        self._wb_phantoms = {k: list(v)
+                             for k, v in self._wb_phantoms_init.items()}
+        super().rebind(tp)  # shape check + graph rewind + local commits
+        self.name = f"{tp.name}@@{self._generation}"
+        self.ce.remote_dep.new_taskpool(self)  # replays parked activations
+        return self
 
     # -- execution ---------------------------------------------------------
     def run(self, nthreads: int = 2) -> int:

@@ -99,7 +99,7 @@ class NativeExecutor:
         consts = tp.constants
         ng = self._native.NativeGraph()
         self._ng = ng
-        index: Dict[Tuple, int] = {}
+        index = self._index = {}
 
         order = list(g.nodes)
         for tid in order:
@@ -125,25 +125,39 @@ class NativeExecutor:
         consts = tp.constants
         cname, locs = tid
         pc = tp.ptg.classes[cname]
-        fn = pc.bodies.get(DEV_CPU)
-        if fn is None:
-            raise ValueError(f"native_exec: class {cname} has no CPU body")
+        # per-class invariants hoisted once (body construction runs per
+        # LOCAL TASK and is a measured chunk of distributed-run startup)
+        cinfo = getattr(self, "_cls_cache", None)
+        if cinfo is None:
+            cinfo = self._cls_cache = {}
+        cached = cinfo.get(cname)
+        if cached is None:
+            fn = pc.bodies.get(DEV_CPU)
+            if fn is None:
+                raise ValueError(
+                    f"native_exec: class {cname} has no CPU body")
+            data_flows = [f for f in pc.flows if f.mode != CTL]
+            base_scalars = {n: consts[n] for n in pc.body_globals}
+            cached = cinfo[cname] = (fn, data_flows, base_scalars)
+        fn, data_flows, base_scalars = cached
         node = g.nodes[tid]
-        env = pc.env_of(locs, consts)
 
         # resolve flow kwargs lazily at execution time: a flow's source
         # payload may be attached after construction, and "new" tiles are
         # shared with whichever predecessor created them
         flow_specs: List[Tuple[str, Optional[Tuple]]] = []
-        for f in pc.flows:
-            if f.mode == CTL:
-                continue
+        for f in data_flows:
             src = node.flow_sources.get(f.name)
             if src is None and not (f.mode & AccessMode.OUT):
                 flow_specs.append((f.name, None))  # unmatched IN: body gets None
             else:
                 flow_specs.append((f.name, source_tile(g, tid, f.name)))
-        scalars = {n: env[n] for n in pc.param_names + pc.def_names + pc.body_globals}
+        scalars = dict(base_scalars)
+        scalars.update(zip(pc.param_names, locs))
+        if pc.def_names:
+            env = pc.env_of(locs, consts)
+            for n in pc.def_names:
+                scalars[n] = env[n]
         # write-back sources are fixed at capture time: resolve the chains
         # once here, not on the hot dispatch path
         write_backs = []
@@ -167,12 +181,14 @@ class NativeExecutor:
             pins.fire(pins.EXEC_END, None, info)
             pins.fire(pins.COMPLETE_EXEC_BEGIN, None, info)
             # write-backs run at producer completion (dynamic runtime's
-            # _write_back); chain successors are DAG-ordered after us
+            # _write_back); chain successors are DAG-ordered after us.
+            # Collections resolve through self.taskpool DYNAMICALLY so a
+            # rebind() onto a same-shape taskpool redirects them.
             for (src, cname2, key) in write_backs:
                 if src is not None:
                     np.copyto(self._payload(("data", cname2, key)),
                               self._payload(src))
-                consts[cname2].data_of(*key).version_bump(0)
+                self.taskpool.constants[cname2].data_of(*key).version_bump(0)
             pins.fire(pins.COMPLETE_EXEC_END, None, info)
 
         return body
@@ -216,6 +232,48 @@ class NativeExecutor:
             # locality hierarchical measurement (steals_remote == 0)
             raise ValueError(f"invalid runtime_vpmap {spec!r}: {e}")
         self._ng.set_vpmap([vm.vp_of(w) for w in range(nthreads)])
+
+    def rebind(self, tp: PTGTaskpool) -> "NativeExecutor":
+        """Re-aim this executor at a SAME-SHAPE taskpool (identical task
+        classes, parameter spaces, scalar globals and collection names —
+        only the collections' tile contents may differ) and rewind the
+        native graph for another run.  Amortizes graph capture + body
+        construction across repeated runs: the iterative-solver pattern,
+        where the reference reuses its compile-time generated structures
+        every iteration.  Shape mismatches fail loudly — silently
+        re-running the old DAG over a larger problem would factor a
+        corner and report success."""
+        self._check_same_shape(tp)
+        self.taskpool = tp
+        self._new_tiles.clear()
+        self._ng.reset()
+        for tid in self.graph.nodes:
+            self._ng.commit(self._index[tid])
+        return self
+
+    def _check_same_shape(self, tp: PTGTaskpool) -> None:
+        """Loud same-shape validation (a pass-1 enumeration — the cheap
+        ~20% of a capture): the new taskpool's global task placement and
+        scalar globals must match the captured structure exactly."""
+        consts = tp.constants
+        fresh = {}
+        for pc in tp.ptg.classes.values():
+            for loc in pc.param_space(consts):
+                fresh[(pc.name, loc)] = pc.rank_of(loc, consts)
+        old = getattr(self.graph, "global_ranks", None)
+        if old is not None and fresh != old:
+            raise ValueError(
+                "rebind: taskpool shape/placement differs from the "
+                f"captured structure ({len(fresh)} vs {len(old)} tasks "
+                "or moved ranks) — build a fresh executor")
+        old_scalars = {k: v for k, v in self.taskpool.constants.items()
+                       if isinstance(v, (int, float, str, bool))}
+        new_scalars = {k: v for k, v in consts.items()
+                       if isinstance(v, (int, float, str, bool))}
+        if old_scalars != new_scalars:
+            raise ValueError(
+                "rebind: scalar globals differ (bodies bake them): "
+                f"{old_scalars} vs {new_scalars}")
 
     def close(self) -> None:
         ng = getattr(self, "_ng", None)

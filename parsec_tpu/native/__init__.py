@@ -122,6 +122,8 @@ def _load():
         lib.pz_graph_steals_remote.argtypes = [ctypes.c_void_p]
         lib.pz_graph_set_vpmap.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
+        lib.pz_graph_reset.restype = ctypes.c_int
+        lib.pz_graph_reset.argtypes = [ctypes.c_void_p]
         lib.pz_graph_run_noop.restype = ctypes.c_int64
         lib.pz_graph_run_noop.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.pz_graph_order.restype = ctypes.c_int64
@@ -259,6 +261,15 @@ class NativeGraph:
     def steals_remote(self) -> int:
         """Cross-VP subset of ``steals`` (0 without a vpmap)."""
         return self._lib.pz_graph_steals_remote(self._g)
+
+    def reset(self) -> None:
+        """Rewind a QUIESCED graph for re-execution over the same
+        structure: every task returns to uncommitted; the caller
+        re-commits exactly as after construction.  Amortizes graph
+        construction across repeated same-shape runs (the reference's
+        compile-time generated structures play this role)."""
+        if self._lib.pz_graph_reset(self._g) != 0:
+            raise RuntimeError("cannot reset: tasks still outstanding")
 
     def set_vpmap(self, vp_of_worker) -> None:
         """Assign each worker id (of the NEXT ``run``) to a VP/locality
