@@ -19,12 +19,18 @@ explicit ``panel_n`` field.
 
 Measurement notes: on this harness the TPU chip is reached through a
 network tunnel whose round-trip (~100 ms) dwarfs kernel times and whose
-``block_until_ready`` does not block. Per-run times therefore come from
-the slope method — time k reps and 2k reps back-to-back (one scalar
-device_get sync each) and take (d2-d1)/k, which cancels the constant
-tunnel offset exactly; reps grow until the slope resolves against
-jitter. The dynamic path times one full taskpool run and subtracts one
-RTT for its final sync.
+``block_until_ready`` does not block.  Two regimes:
+* small results (flagship/QR/LU stages): the SLOPE method — time k reps
+  and 2k reps back-to-back (one scalar device_get sync each), take
+  (d2-d1)/k; the constant tunnel offset cancels exactly.
+* whole-matrix results (the panel stage): the slope method's k
+  back-to-back reps would put k 4-GiB buffers in flight and OOM the
+  chip, so reps are SERIALIZED (one buffer in flight, per-rep element
+  sync, the RTT subtracted once, min of 3) and the copy baseline comes
+  from differencing two chained-copy program lengths — RTT-free, so
+  nothing is subtracted twice.
+The dynamic path times one full taskpool run and subtracts one RTT for
+its final sync.
 
 Config via env: BENCH_N (matrix size), BENCH_NB (tile size), BENCH_DTYPE,
 BENCH_REPS, BENCH_PLATFORM (force backend, e.g. "cpu" for smoke),
@@ -103,7 +109,10 @@ def main() -> None:
     fields: dict = {}
 
     def sync_scalar(x):
-        jax.device_get(x.ravel()[0])
+        # element-index, never ravel: x.ravel() materializes a full
+        # device copy of x first — at the north-star size that is +4 GiB
+        # per sync (the r04 dry run OOMed on exactly this)
+        jax.device_get(x[(0,) * getattr(x, "ndim", 0)])
 
     # tunnel round-trip estimate (scalar fetch of a ready array)
     tiny = jnp.zeros(8)
@@ -151,30 +160,36 @@ def main() -> None:
 
     reps = int(os.environ.get("BENCH_REPS", "5"))
 
-    # ---- STAGE 1 (north star, runs FIRST): panel Cholesky --------------
-    # Whole-program AND runtime paths at the north-star size; the stage
-    # BASELINE.json actually names must be the LAST one at risk when the
-    # tunnel is slow, so it runs before everything optional.
-    if on_accel and os.environ.get("BENCH_PANEL", "1") != "0":
-        panel_n = int(os.environ.get("BENCH_PANEL_N", "32768"))
-        panel_nb = int(os.environ.get("BENCH_PANEL_NB", "512"))
-        try:
-            panel_stage(panel_n, panel_nb, measure, fields)
-        except (KeyboardInterrupt, SystemExit):
-            raise
-        except BaseException as e:
-            # stage-internal legs already retried; anything escaping here
-            # (preamble, copy-cost measurement) must not zero the run —
-            # fields already merged stay, the flagship stage still runs
-            print(f"panel stage aborted: {e!r}", file=sys.stderr)
-            traceback.print_exc()
-            fields["panel_stage_error"] = f"{type(e).__name__}: {e}"[:200]
-
-    # ---- STAGE 2 (flagship graph + headline metric) --------------------
-    # From here on, the output line prints NO MATTER WHAT (finally):
-    # stage 1's already-measured north-star fields must survive any
-    # stage-2+ failure, including the driver's own Ctrl-C/timeout signal.
+    # The output line prints NO MATTER WHAT (finally) — already-measured
+    # fields must survive any later failure, INCLUDING an interrupt or
+    # driver timeout during the long stage-1 panel stage.
     try:
+        # ---- STAGE 1 (north star, runs FIRST): panel Cholesky ----------
+        # Whole-program AND runtime paths at the north-star size; the
+        # stage BASELINE.json actually names must be the LAST one at risk
+        # when the tunnel is slow, so it runs before everything optional.
+        if on_accel and os.environ.get("BENCH_PANEL", "1") != "0":
+            panel_n = int(os.environ.get("BENCH_PANEL_N", "32768"))
+            panel_nb = int(os.environ.get("BENCH_PANEL_NB", "512"))
+            try:
+                panel_stage(panel_n, panel_nb, rtt, fields)
+            except (KeyboardInterrupt, SystemExit):
+                raise  # outer finally still prints what was measured
+            except BaseException as e:
+                # stage-internal legs already retried; anything escaping
+                # here must not zero the run — fields already merged
+                # stay, the flagship stage still runs
+                print(f"panel stage aborted: {e!r}", file=sys.stderr)
+                traceback.print_exc()
+                fields["panel_stage_error"] = \
+                    f"{type(e).__name__}: {e}"[:200]
+            # the panel stage holds multi-GiB device buffers; make sure
+            # they are really released before the flagship allocates
+            import gc
+
+            gc.collect()
+
+        # ---- STAGE 2+ (flagship graph + headline metric) ---------------
         _rest_of_main(N, NB, dtype, backend, on_accel, reps, rtt,
                       measure, sync_scalar, fields)
     finally:
@@ -340,14 +355,16 @@ def _rest_of_main(N, NB, dtype, backend, on_accel, reps, rtt,
                    measure, fields)
 
 
-def panel_stage(n: int, nb: int, measure, fields: dict) -> None:
+def panel_stage(n: int, nb: int, rtt: float, fields: dict) -> None:
     """North-star panel dpotrf: the whole-program trace AND the runtime
     (taskpool+scheduler+device) path, interleaved under the same tunnel
     conditions; merges fields into ``fields`` AS each leg completes (a
     later failure keeps everything already measured).  Every measured rep
     factorizes a REAL SPD matrix (a fresh device copy of the pristine
-    input — never the previous output); the copy's own slope-measured
-    cost is subtracted.  Numerics-gated on-device by sampled
+    input — never the previous output); reps are serialized (one buffer
+    in flight), the RTT is subtracted once, and the copy's own cost comes
+    from the RTT-free chained-copy baseline.  Numerics-gated on-device by
+    sampled
     reconstruction (scalar fetch only — no N^2 transfers); both paths run
     XLA's default TPU matmul precision, hence the 1e-2 bf16-class gate
     (the f32 graph variants keep 1e-3)."""
@@ -380,24 +397,79 @@ def panel_stage(n: int, nb: int, measure, fields: dict) -> None:
 
     @jax.jit
     def gate(L):
-        # sampled reconstruction |(L L^T - S)[idx, idx]| — O(N * samples)
-        # on device, scalar fetch only (a monolithic chol of the same N
-        # as oracle would cost more than the whole measurement).  HIGHEST
-        # gate matmul: measure the FACTORIZATION's error, not the gate's
+        # sampled reconstruction vs the CLOSED-FORM KMS oracle — O(n *
+        # samples) device memory and compute, scalar fetch only.  The
+        # round-3 gate materialized a SECOND n x n oracle matrix AND a
+        # tril copy inside the gate: at the true north-star size that is
+        # +8 GiB on a 16 GiB chip — the r04 dry run OOMed exactly there
+        # (and a wedged PJRT backend then failed every later stage).
+        # tril-row trick: rec[a, b] = sum_{k <= min(ia, ib)} L[ia,k] L[ib,k]
+        # = (R * mask) (R * mask)^T with R = L[idx] and mask[a, k] =
+        # (k <= idx[a]).  HIGHEST gate matmul: measure the
+        # FACTORIZATION's error, not the gate's.
         from jax.lax import Precision
 
-        S = make_spd()
-        Lt = jnp.tril(L)
-        idx = jax.random.choice(jax.random.PRNGKey(3), n, (256,),
-                                replace=False)
-        rec = jnp.matmul(Lt[idx], Lt.T[:, idx], precision=Precision.HIGHEST)
-        return jnp.abs(rec - S[jnp.ix_(idx, idx)]).max() / jnp.abs(S).max()
+        idx = jnp.sort(jax.random.choice(jax.random.PRNGKey(3), n, (256,),
+                                         replace=False))
+        # gather FIRST, upcast the 256 x n rows after: upcasting a bf16
+        # result matrix to f32 before the gate costs +4 GiB at the
+        # north-star size (another r04 dry-run OOM)
+        R = L[idx, :].astype(jnp.float32)               # (256, n) gather
+        M = R * (jnp.arange(n)[None, :] <= idx[:, None])
+        rec = jnp.matmul(M, M.T, precision=Precision.HIGHEST)
+        d = jnp.abs(idx[:, None] - idx[None, :]).astype(jnp.float32)
+        S = jnp.exp2(-d) + 3.0 * jnp.eye(256, dtype=jnp.float32)
+        return jnp.abs(rec - S).max() / 4.0  # |S|.max() = 1 + 3 on-diag
 
     copy = jax.jit(lambda x: x + 0.0)
     pristine = make_spd()
-    jax.device_get(pristine.ravel()[0])
+    jax.device_get(pristine[0, 0])  # element sync — never ravel (+4 GiB)
     flops = n**3 / 3.0
     nb_cores = int(os.environ.get("BENCH_CORES", "2"))
+
+    # SERIALIZED measurement for the panel legs: each fn() result is a
+    # whole n x n matrix — the slope method's k back-to-back reps put
+    # k 4-GiB buffers in flight at the north-star size and OOM a 16-GiB
+    # chip.  One buffer in flight, per-rep sync, the tunnel RTT
+    # subtracted ONCE, min of 3 — the r03 in-session 32768 methodology.
+    def measure_serial(fn, _reps=3):
+        best = None
+        for _ in range(_reps):
+            t0 = time.perf_counter()
+            r = fn()
+            jax.device_get(r[(0,) * r.ndim])  # element sync, no ravel copy
+            dt = time.perf_counter() - t0
+            del r  # ONE result buffer in flight at a time
+            dt = _minus_cost(dt, rtt)
+            best = dt if best is None else min(best, dt)
+        return max(best, 1e-9)
+
+    def copy_cost(arr=None) -> float:
+        # RTT-FREE copy baseline: a serialized measure of copy() keeps
+        # its full tunnel RTT (the copy itself is below the _minus_cost
+        # threshold), and subtracting THAT from an already-RTT-subtracted
+        # leg double-counts the RTT — inflating every field by ~rtt/run.
+        # Chain k dependent copies inside ONE program and difference two
+        # chain lengths: the RTT and dispatch offsets cancel exactly,
+        # with a single buffer in flight.
+        def chain(k):
+            return jax.jit(lambda x: lax.fori_loop(
+                0, k, lambda i, y: y + 0.0, x))
+
+        src = pristine if arr is None else arr
+        c1, c5 = chain(1), chain(5)
+        walls = {}
+        for name, f in (("c1", c1), ("c5", c5)):
+            best = None
+            for _ in range(3):
+                t0 = time.perf_counter()
+                r = f(src)
+                jax.device_get(r[0, 0])
+                dt = time.perf_counter() - t0
+                del r
+                best = dt if best is None else min(best, dt)
+            walls[name] = best
+        return max((walls["c5"] - walls["c1"]) / 4.0, 0.0)
 
     # -- whole-program leg (the runtime-bypassing ceiling) ---------------
     state: dict = {}
@@ -451,7 +523,7 @@ def panel_stage(n: int, nb: int, measure, fields: dict) -> None:
     tag = "" if max(err_w, state.get("err_r", 0.0)) <= 1e-3 else "_bf16"
 
     try:
-        t_copy = measure(lambda: copy(pristine), 2)
+        t_copy = copy_cost()
         # interleaved, best of two rounds per path: the tunnel's enqueue-
         # latency jitter starves any multi-program path of the device
         # (the whole-program trace is immune only because it is ONE
@@ -463,14 +535,14 @@ def panel_stage(n: int, nb: int, measure, fields: dict) -> None:
         rkey = f"runtime_chol_N{n}_nb{nb}{tag}_gflops"
 
         def round_pair():
-            t_w = _minus_cost(measure(lambda: wc.run(copy(pristine)), 2),
+            t_w = _minus_cost(measure_serial(lambda: wc.run(copy(pristine))),
                               t_copy)
             fields[wkey] = max(fields.get(wkey, 0.0),
                                round(flops / t_w / 1e9, 2))
             if have_rt:
                 sc = state["sc"]
                 t_r = _minus_cost(
-                    measure(lambda: sc.run(copy(pristine)), 2), t_copy)
+                    measure_serial(lambda: sc.run(copy(pristine))), t_copy)
                 fields[rkey] = max(fields.get(rkey, 0.0),
                                    round(flops / t_r / 1e9, 2))
             if fields.get(wkey) and fields.get(rkey):
@@ -480,37 +552,35 @@ def panel_stage(n: int, nb: int, measure, fields: dict) -> None:
         _leg(fields, "panel_round1", round_pair)
         _leg(fields, "panel_round2", round_pair)
 
-        to_f32 = jax.jit(lambda x: x.astype(jnp.float32))
-
         def precision_leg(variant, suffix, feed, extra):
             """Gate + min-of-2 interleaved measurement of one mixed-
             precision (whole, runtime) pair; merges suffixed fields, or
             nothing if the 1e-2 bf16-class gate fails."""
             ctx = state.get("ctx")
             wcv = WholeCholesky(n, nb, strip=4096, bf16=variant)
-            err_w2 = float(gate(to_f32(wcv.run(copy(feed)))))
+            err_w2 = float(gate(wcv.run(copy(feed))))  # gate upcasts rows
             scv = None
             if ctx is not None:
                 scv = SegmentedCholesky(ctx, n, nb, strip=4096, tail=8192,
                                         bf16=variant)
-                err_r2 = float(gate(to_f32(scv.run(copy(feed)))))
+                err_r2 = float(gate(scv.run(copy(feed))))
             else:
                 err_r2 = 0.0
             if not (np.isfinite(err_w2) and err_w2 <= 1e-2
                     and np.isfinite(err_r2) and err_r2 <= 1e-2):
                 raise RuntimeError(
                     f"{suffix} panel leg numerics off ({err_w2}/{err_r2})")
-            t_c = measure(lambda: copy(feed), 2)
+            t_c = copy_cost(feed)  # feed dtype's own copy cost
             wk = f"whole_chol_N{n}_nb{nb}_{suffix}_gflops"
             rk = f"runtime_chol_N{n}_nb{nb}_{suffix}_gflops"
             for _ in range(2):
-                t_w = _minus_cost(measure(lambda: wcv.run(copy(feed)), 2),
-                                  t_c)
+                t_w = _minus_cost(
+                    measure_serial(lambda: wcv.run(copy(feed))), t_c)
                 fields[wk] = max(fields.get(wk, 0.0),
                                  round(flops / t_w / 1e9, 2))
                 if scv is not None:
                     t_r = _minus_cost(
-                        measure(lambda: scv.run(copy(feed)), 2), t_c)
+                        measure_serial(lambda: scv.run(copy(feed))), t_c)
                     fields[rk] = max(fields.get(rk, 0.0),
                                      round(flops / t_r / 1e9, 2))
             fields.update(extra(max(err_w2, err_r2)))
@@ -529,11 +599,16 @@ def panel_stage(n: int, nb: int, measure, fields: dict) -> None:
         # compute precision)
         if os.environ.get("BENCH_PANEL_STOREBF16", "1") != "0" \
                 and not _over_budget(0.55, "bf16-storage leg"):
-            pristine_b = jax.jit(lambda x: x.astype(jnp.bfloat16))(pristine)
-            _leg(fields, "panel_bf16storage",
-                 lambda: precision_leg(
-                     "storage", "bf16storage", pristine_b,
-                     lambda e: {"bf16storage_err": float(f"{e:.2e}")}))
+            def storage_leg():
+                # the bf16 cast happens INSIDE the leg so an OOM here is
+                # retried/recorded, never aborts the stage
+                pristine_b = jax.jit(
+                    lambda x: x.astype(jnp.bfloat16))(pristine)
+                precision_leg(
+                    "storage", "bf16storage", pristine_b,
+                    lambda e: {"bf16storage_err": float(f"{e:.2e}")})
+
+            _leg(fields, "panel_bf16storage", storage_leg)
     finally:
         ctx = state.get("ctx")
         if ctx is not None:
@@ -559,7 +634,7 @@ def qrlu_stage(n: int, nb: int, measure, fields: dict) -> None:
     A_lu = jax.jit(lambda: jax.random.normal(
         jax.random.PRNGKey(12), (n, n), jnp.float32)
         + n * jnp.eye(n, dtype=jnp.float32))()  # dd: nopiv-class input
-    jax.device_get(A_qr.ravel()[0])
+    jax.device_get(A_qr[0, 0])
     copy = jax.jit(lambda x: x + 0.0)
     idx = np.random.default_rng(13).choice(n, 256, replace=False)
     idx_dev = jnp.asarray(np.sort(idx))

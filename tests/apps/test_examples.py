@@ -25,6 +25,7 @@ ALL = [
     "ex11_pallas_native.py",
     "ex12_qr_lu.py",
     "ex13_segmented_native_dist.py",
+    "ex14_round4_features.py",
     os.path.join("dtd", "dtd_helloworld.py"),
     os.path.join("dtd", "dtd_hello_arg.py"),
     os.path.join("dtd", "dtd_untied.py"),
