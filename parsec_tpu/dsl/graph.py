@@ -157,7 +157,14 @@ def capture(tp: PTGTaskpool, ranks: Optional[Iterable[int]] = None) -> TaskGraph
                 node.flow_sources[f.name] = ("data", src.collection_name, src.key(env))
             else:  # _TaskRef
                 key = tuple(a.scalar(env) for a in src.args)
-                node.flow_sources[f.name] = ("task", (src.class_name, key), src.flow_name)
+                if (src.class_name, key) not in g.global_ranks:
+                    # out-of-range producer reference: the input does not
+                    # exist (reference complex_deps off-diagonal corner)
+                    node.flow_sources[f.name] = \
+                        ("new",) if (f.mode & AccessMode.OUT) else None
+                else:
+                    node.flow_sources[f.name] = (
+                        "task", (src.class_name, key), src.flow_name)
             # output edges
             for dep in f.deps_out:
                 t = dep.target(env)

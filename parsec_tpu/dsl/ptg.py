@@ -518,9 +518,29 @@ class PTGTaskClass:
                         for locs in _expand_args(t.args, env):
                             if len(locs) == len(src_pc.param_names) and src_pc.valid(locs, constants):
                                 goal += 1
-            elif isinstance(self.active_input(f, env), _TaskRef):
-                goal += 1
+            else:
+                t = self.active_input(f, env)
+                if isinstance(t, _TaskRef):
+                    # an input whose producer reference falls OUTSIDE the
+                    # producer's parameter space does not exist — it must
+                    # not count toward the goal (reference complex_deps:
+                    # FCT3(i,k,j>k) reads FCT2(i,j,k), valid only on the
+                    # diagonal; off-diagonal instances run without it).
+                    # Arg-evaluation errors PROPAGATE — _resolve_input
+                    # evaluates the same expressions unguarded, and the
+                    # two must agree or goals desync from resolution.
+                    src_pc = self.ptg.classes[t.class_name]
+                    locs = tuple(a.scalar(env) for a in t.args)
+                    if src_pc.instance_exists(locs, constants):
+                        goal += 1
         return goal
+
+    def instance_exists(self, key: Tuple, constants: Dict[str, Any]) -> bool:
+        """True when ``key`` names a real instance of this class — the
+        ONE predicate behind goal counting, input resolution and capture
+        (a dep referencing a non-instance does not exist; reference
+        complex_deps off-diagonal corner)."""
+        return len(key) == len(self.param_names) and self.valid(key, constants)
 
     def rank_of(self, locals_: Tuple, constants: Dict[str, Any]) -> int:
         if self._affinity is None:
@@ -882,6 +902,14 @@ class PTGTaskpool(Taskpool):
         key = tuple(a.scalar(env) for a in target.args)
         entry = self.repos[src_pc.name].consume(key)
         if entry is None:
+            # miss: either an out-of-range producer reference (the input
+            # does not exist — goal_of excluded it; rare, so the
+            # existence scan runs only here, off the hot path) or a real
+            # asymmetric-deps bug
+            if not src_pc.instance_exists(key, self.constants):
+                if f.mode & AccessMode.OUT:
+                    return self._new_tile(pc, f, task)
+                return None
             raise RuntimeError(
                 f"{task!r}: producer {target.class_name}{key} left no repo "
                 f"entry for flow {target.flow_name!r} (asymmetric deps?)")
@@ -1243,6 +1271,16 @@ def _make_cpu_hook(pc: PTGTaskClass, fn: Callable):
         if wants_this_task:
             kw["this_task"] = task
         result = fn(**kw)
+        if isinstance(result, HookReturn):
+            # reference BODY semantics: a body may return a hook status —
+            # ASYNC (e.g. recursive_invoke spawned a nested pool that owns
+            # completion), NEXT (decline this incarnation), AGAIN — those
+            # bypass the commit, which is the eventual completer's
+            # business.  DONE falls THROUGH: the normal post-body commit
+            # (payload rebinds + version bumps) must still run.
+            if result is not HookReturn.DONE:
+                return result
+            result = None
         if result is not None:
             outs = result if isinstance(result, (tuple, list)) else (result,)
             if len(outs) != len(writable):

@@ -714,3 +714,189 @@ END
         expect = 2.0 + k * BLOCK + np.arange(BLOCK)
         np.testing.assert_allclose(
             dc.data_of(k).newest_copy().payload, expect)
+
+
+def test_complex_deps(ctx):
+    """complex_deps.jdf: the five-class dependency web — per-(i,k) chains
+    on TWO flows of FCT1, range fan-outs into THREE-parameter consumer
+    classes with PERMUTED arguments (FCT2(i,k,j) feeds FCT3(i,j,k)), and
+    side taps FCT4/FCT5.  (The reference's [displ_remote=...] payload
+    displacements are wire-layout props; they parse and pass through.)"""
+    src = """
+A  [ type = "collection" ]
+NI [ type = int ]
+NK [ type = int ]
+
+FCT1(i, k)
+
+  i = 0 .. NI-1
+  k = 0 .. NK-1
+
+: A( i )
+
+    READ A <- (0 == k) ? A(i) : A FCT1(i, k-1)
+         -> (NK != k+1) ? A FCT1(i, k+1)
+         -> A FCT5(i, k)                         [displ_remote = 10]
+    RW   B <- (0 == k) ? A(i) : B FCT1(i, k-1)
+         -> A FCT2(i, k, k .. NK)                [displ_remote = 0]
+         -> A FCT3(i, k, k .. NK)                [displ_remote = 10]
+         -> A FCT4(i, k)
+         -> (NK != k+1) ? B FCT1(i, k+1)
+
+BODY
+{
+    counts.inc("FCT1")
+}
+END
+
+FCT2(i, k, j)
+
+  i = 0 .. NI-1
+  k = 0 .. NK-1
+  j = k .. NK
+
+: A( i )
+
+  READ A <- B FCT1(i, k)
+         -> B FCT3(i, j, k)
+
+BODY
+{
+    counts.inc("FCT2")
+}
+END
+
+FCT3(i, k, j)
+
+  i = 0 .. NI-1
+  k = 0 .. NK-1
+  j = k .. NK
+
+: A( i )
+
+  READ A <- B FCT1(i, k)
+  READ B <- A FCT2(i, j, k)
+
+BODY
+{
+    counts.inc("FCT3")
+}
+END
+
+FCT4(i, k)
+
+  i = 0 .. NI-1
+  k = 0 .. NK-1
+
+: A( i )
+
+  READ A <- B FCT1(i, k)
+
+BODY
+{
+    counts.inc("FCT4")
+}
+END
+
+FCT5(i, k)
+
+  i = 0 .. NI-1
+  k = 0 .. NK-1
+
+: A( i )
+
+  READ A <- A FCT1(i, k)
+
+BODY
+{
+    counts.inc("FCT5")
+}
+END
+"""
+    import collections
+    import threading as _t
+
+    lock = _t.Lock()
+    data = collections.Counter()
+
+    class Counts:
+        def inc(self, name):
+            with lock:
+                data[name] += 1
+
+    NI, NK = 2, 3
+    jdf = compile_jdf(src, "cdeps", namespace={"counts": Counts()})
+    dc = LocalCollection("A", shape=(4,), init=lambda k: np.zeros(4))
+    tp = jdf.new(A=dc, NI=NI, NK=NK)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=60)
+    fan = NI * sum(NK - k + 1 for k in range(NK))  # j = k .. NK inclusive
+    assert data["FCT1"] == NI * NK
+    assert data["FCT2"] == fan
+    # FCT3(i,k,j) instances consume FCT2(i,j,k) — the permuted pairing
+    # covers the SAME triangle, every instance must run
+    assert data["FCT3"] == fan
+    assert data["FCT4"] == NI * NK
+    assert data["FCT5"] == NI * NK
+
+
+def test_recursive_body():
+    """recursive.jdf: a BODY that spawns a NESTED taskpool of the same
+    JDF at level-1 and completes asynchronously when it quiesces
+    (reference parsec_recursivecall); level 0 falls through to the plain
+    compute.  Reference bodies return PARSEC_HOOK_RETURN_* — the port
+    returns HookReturn.ASYNC from recursive_invoke.  ONE worker: sibling
+    subpools write the whole shared collection with no cross-POOL
+    dependency tracking (the reference recurses on each parent's own
+    subtile), so a single worker serializes the read-modify-writes and
+    keeps the expected count deterministic."""
+    from parsec_tpu import Context as _Ctx
+
+    ctx = _Ctx(nb_cores=1)
+    src = """
+A     [ type = "collection" ]
+level [ type = int ]
+NI    [ type = int ]
+
+DO_SOMETHING(i)
+
+  i = 0 .. NI-1
+
+: A( i )
+
+RW X <- A( i )
+     -> A( i )
+
+BODY
+{
+    if level == 0:
+        X[:] = X + 1.0
+        return
+    sub = make_sub(level - 1)
+    return recursive_invoke(None, this_task, sub)
+}
+END
+"""
+    from parsec_tpu.core.recursive import recursive_invoke
+
+    NI, LEVEL = 2, 2
+    dc = LocalCollection("A", shape=(2,), init=lambda k: np.zeros(2))
+    holder = {}
+
+    def make_sub(lvl):
+        return holder["jdf"].new(A=dc, level=lvl, NI=NI)
+
+    jdf = compile_jdf(src, "recjdf", namespace={
+        "make_sub": make_sub, "recursive_invoke": recursive_invoke})
+    holder["jdf"] = jdf
+    tp = jdf.new(A=dc, level=LEVEL, NI=NI)
+    try:
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=60)
+    finally:
+        ctx.fini()
+    # every level-L task spawns a FULL NI-task pool at L-1: NI^LEVEL
+    # leaf pools each add 1 to every element
+    for i in range(NI):
+        np.testing.assert_allclose(
+            dc.data_of(i).newest_copy().payload, float(NI ** LEVEL))
