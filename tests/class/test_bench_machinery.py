@@ -1,0 +1,62 @@
+"""The bench's evidence machinery is load-bearing (round-3 VERDICT #1:
+the driver artifact IS the number of record) — pin its helpers.
+
+Covers: incremental field merge under leg failure, the single retry with
+interrupt passthrough, fixed-cost subtraction guards, and the budget
+shedding thresholds.  (The always-print finally in ``main`` is exercised
+end-to-end by the driver-method runs, not here.)"""
+
+import pytest
+
+import bench
+
+
+def test_minus_cost_guard():
+    # subtract only when the run dwarfs the cost
+    assert bench._minus_cost(1.0, 0.1) == pytest.approx(0.9)
+    # below the 2x threshold: no subtraction (noise would go negative)
+    assert bench._minus_cost(0.15, 0.1) == pytest.approx(0.15)
+    assert bench._minus_cost(0.0, 0.1) == 0.0
+
+
+def test_leg_retries_once_then_records_error(monkeypatch):
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)  # skip backoff
+    fields = {}
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("transient")
+        fields["x"] = 1
+
+    assert bench._leg(fields, "demo", flaky) is True
+    assert fields["x"] == 1 and len(calls) == 2
+    assert "demo_error" not in fields
+
+    fields2 = {}
+
+    def broken():
+        fields2["partial"] = 7  # merged BEFORE the failure
+        raise ValueError("persistent")
+
+    assert bench._leg(fields2, "bad", broken) is False
+    # the error is recorded AND the partial field survives
+    assert fields2["partial"] == 7
+    assert fields2["bad_error"].startswith("ValueError")
+
+
+def test_leg_interrupt_passes_through():
+    def interrupted():
+        raise KeyboardInterrupt
+
+    with pytest.raises(KeyboardInterrupt):
+        bench._leg({}, "ki", interrupted)
+
+
+def test_over_budget_threshold(monkeypatch):
+    monkeypatch.setattr(bench, "_BUDGET", 100.0)
+    monkeypatch.setattr(bench.time, "perf_counter",
+                        lambda: bench._T_START + 90.0)
+    assert bench._over_budget(0.85, "x") is True
+    assert bench._over_budget(0.95, "x") is False
