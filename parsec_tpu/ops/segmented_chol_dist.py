@@ -78,11 +78,65 @@ def _make_upd_body(n: int, nb: int):
     return upd
 
 
-def dist_segmented_cholesky_ptg(n: int, nb: int) -> PTG:
+def _make_panel_body_cpu(n: int, nb: int):
+    def panel(M, P, k):
+        k0 = k * nb
+        D = M[k0:k0 + nb, :]
+        L = np.linalg.cholesky(D)
+        W = np.linalg.inv(L)
+        C = M @ W.T  # full-height column solve (junk rows above: upper)
+        C[k0:k0 + nb, :] = np.tril(L)
+        C[:k0, :] = 0.0
+        M[:] = C
+        P[:] = C
+
+    return panel
+
+
+def _make_upd_body_cpu(n: int, nb: int):
+    def upd(T, P, k, j):
+        j0 = j * nb
+        T -= P @ P[j0:j0 + nb, :].T
+
+    return upd
+
+
+def _cpu_is_fallback_only(task) -> bool:
+    """CPU incarnation evaluate hook: eligible only when the context has
+    no enabled TPU device — a FALLBACK, never a competitor that the ETA
+    selector could route hot-path panels onto mid-benchmark."""
+    from ..core.lifecycle import DEV_TPU
+
+    ctx = task.taskpool.context
+    return not any(d.device_type == DEV_TPU and d.enabled
+                   for d in (ctx.devices if ctx is not None else ()))
+
+
+def _select_bodies(pc, tpu_body, cpu_body, use_tpu: bool,
+                   use_cpu: bool) -> None:
+    bodies = {}
+    if use_tpu and tpu_body is not None:
+        bodies["tpu"] = tpu_body
+    if use_cpu:
+        bodies["cpu"] = cpu_body
+        pc.evaluate_hook("cpu", _cpu_is_fallback_only)
+    if not bodies:
+        raise ValueError(
+            f"{pc.name}: no BODY selected (use_tpu={use_tpu} needs jax; "
+            f"use_cpu={use_cpu})")
+    pc.body(**bodies)
+
+
+def dist_segmented_cholesky_ptg(n: int, nb: int, *, use_tpu: bool = True,
+                                use_cpu: bool = True) -> PTG:
     """Build the distributed segmented dpotrf PTG.  Instantiate with
     ``.taskpool(NT=n//nb, C=collection, TILE_SHAPE=(n, nb))`` where
     ``C(j)`` is the full-height column block j, distributed by the
-    collection's ``rank_of``."""
+    collection's ``rank_of``.  The device (functional jax) incarnation is
+    primary; the CPU (in-place numpy) incarnation is a FALLBACK gated by
+    an evaluate hook — eligible only in contexts with no TPU device (the
+    TCP driver's CPU-only subprocesses), never competing for device-run
+    tasks."""
     if n % nb:
         raise ValueError(f"N={n} not divisible by nb={nb}")
     ptg = PTG("dpotrf_seg_dist")
@@ -94,7 +148,8 @@ def dist_segmented_cholesky_ptg(n: int, nb: int) -> PTG:
                "-> C(k)")
     panel.flow("P", OUT,
                "-> (k < NT-1) ? P upd(k, k+1 .. NT-1)")
-    panel.body(tpu=_make_panel_body(n, nb))
+    _select_bodies(panel, _make_panel_body(n, nb) if jax else None,
+                   _make_panel_body_cpu(n, nb), use_tpu, use_cpu)
 
     upd = ptg.task_class("upd", k="0 .. NT-2", j="k+1 .. NT-1")
     upd.affinity("C(j)")
@@ -103,7 +158,8 @@ def dist_segmented_cholesky_ptg(n: int, nb: int) -> PTG:
              "<- (k == 0) ? C(j) : T upd(k-1, j)",
              "-> (j == k+1) ? M panel(j) : T upd(k+1, j)")
     upd.flow("P", IN, "<- P panel(k)")
-    upd.body(tpu=_make_upd_body(n, nb))
+    _select_bodies(upd, _make_upd_body(n, nb) if jax else None,
+                   _make_upd_body_cpu(n, nb), use_tpu, use_cpu)
     return ptg
 
 
@@ -183,9 +239,11 @@ def run_dist_segmented_cholesky(nranks: int, n: int, nb: int, *,
         execd = 0
         d2d = 0
         for r, dc in cols.items():
-            dev = next(d for d in ctxs[r].devices if d.mca_name == "tpu")
-            execd += dev.stats["executed_tasks"]
-            d2d += dev.stats["bytes_d2d"]
+            # count across ALL devices: at scale the selector may route
+            # some tasks to the CPU fallback, and a tpu-only count would
+            # silently undercount (bytes_d2d is simply 0 off-device)
+            execd += sum(d.stats["executed_tasks"] for d in ctxs[r].devices)
+            d2d += sum(d.stats.get("bytes_d2d", 0) for d in ctxs[r].devices)
             for j in range(NT):
                 if j % nranks != r:
                     continue
