@@ -352,9 +352,17 @@ class Context:
             self._run_task(es, task)
 
     def _run_task(self, es: ExecutionStream, task: Task) -> None:
-        """Progress one task, containing body exceptions: a raising task is
-        reported and retired so the taskpool still quiesces (the reference
-        aborts on hook ERROR; we degrade to a logged error per task)."""
+        """Progress one task.  A raising body FAILS the pool — loudly
+        and immediately, exactly like a device submit failure (round-4
+        discipline, ``device/tpu.py _fail_task_pool``; reference
+        hook-ERROR is fatal, ``scheduling.c:512``): ``wait()`` returns
+        False at once, the pool leaves the active set, and its remaining
+        queued tasks are discarded by ``_next_task`` (abort semantics) —
+        they would only have consumed the failed task's stale data.  The
+        old contain-and-continue policy let a raising producer forward
+        its UNMODIFIED input downstream and report success (found by the
+        dtt_pingpong port, round 5).  Local fail only, for the same
+        parked-abort reason the device layer documents."""
         es.stats["executed"] += 1
         try:
             scheduling.task_progress(self, es, task)
@@ -365,22 +373,20 @@ class Context:
             import traceback
 
             traceback.print_exc()
-            # run the completion side anyway: successors must be released and
-            # completion callbacks fired or the taskpool never quiesces. A
-            # device-manager hook may have ALREADY completed this task before
-            # raising on someone else's behalf — task.retired guards that.
-            from .lifecycle import TaskStatus
+            from ..comm.remote_dep import _fail_pool
 
-            if task.retired:
-                pass
-            elif task.status < TaskStatus.PREPARE_OUTPUT:
-                try:
-                    scheduling.complete_execution(self, es, task)
-                except Exception as e2:
-                    debug.error("completion of failed task %r also raised: %s", task, e2)
-                    if not task.retired:
-                        task.taskpool.task_done(task)
-            else:  # raised inside this task's completion path: just retire
+            _fail_pool(task.taskpool,
+                       f"task {task!r} body raised: {type(e).__name__}: {e}")
+            # do NOT run the completion side: release_deps would forward
+            # the failed task's stale payloads to REMOTE successors (and
+            # write stale data back to remote home tiles) — healthy peer
+            # ranks would consume them before discovering the loss.  The
+            # pool is already force-terminated, so nothing waits on its
+            # counters; just retire the task for the bookkeeping.  A
+            # device-manager hook may have ALREADY completed this task
+            # before raising on someone else's behalf — task.retired
+            # guards that.
+            if not task.retired:
                 task.taskpool.task_done(task)
 
     def _notify_work(self) -> None:

@@ -501,12 +501,15 @@ class PTGTaskClass:
                 return True
         return False
 
-    def goal_of(self, locals_: Tuple, constants: Dict[str, Any]) -> int:
+    def goal_of(self, locals_: Tuple, constants: Dict[str, Any],
+                memo: Optional[Dict] = None) -> int:
         """Counter-mode dependency goal. Data flows have exactly one active
         source (guarded alternatives, JDF single-assignment); CTL flows
         *gather*: every guard-true dep contributes one dependency per
         instance of its (possibly ranged) task reference (reference
-        controlgather semantics)."""
+        controlgather semantics).  ``memo`` forwards to
+        :meth:`instance_exists` (existence is constants-only, cacheable
+        even under dynamic guards)."""
         env = self.env_of(locals_, constants)
         goal = 0
         for f in self.flows:
@@ -531,15 +534,33 @@ class PTGTaskClass:
                     # two must agree or goals desync from resolution.
                     src_pc = self.ptg.classes[t.class_name]
                     locs = tuple(a.scalar(env) for a in t.args)
-                    if src_pc.instance_exists(locs, constants):
+                    if src_pc.instance_exists(locs, constants, memo):
                         goal += 1
         return goal
 
-    def instance_exists(self, key: Tuple, constants: Dict[str, Any]) -> bool:
+    def instance_exists(self, key: Tuple, constants: Dict[str, Any],
+                        memo: Optional[Dict] = None) -> bool:
         """True when ``key`` names a real instance of this class — the
         ONE predicate behind goal counting, input resolution and capture
         (a dep referencing a non-instance does not exist; reference
-        complex_deps off-diagonal corner)."""
+        complex_deps off-diagonal corner).
+
+        This is a direct predicate evaluation — O(#params) with O(1)
+        range-membership per param (``valid`` walks the declarations, it
+        never enumerates the producer's parameter space), matching the
+        reference's O(1) predecessor predicates in generated code
+        (``jdf2c.c``).  ``memo`` (the taskpool's per-instance dict, safe
+        because existence depends only on the taskpool constants, never
+        on dynamic guard state) bounds even that to one evaluation per
+        distinct (class, key) under guard-heavy webs that re-derive the
+        same reference per input."""
+        if memo is not None:
+            mk = (self.name, key)
+            r = memo.get(mk)
+            if r is None:
+                r = memo[mk] = (len(key) == len(self.param_names)
+                                and self.valid(key, constants))
+            return r
         return len(key) == len(self.param_names) and self.valid(key, constants)
 
     def rank_of(self, locals_: Tuple, constants: Dict[str, Any]) -> int:
@@ -606,6 +627,13 @@ class PTGTaskpool(Taskpool):
         #: decide to schedule one — whoever claims first wins
         self._source_claims: set = set()
         self._claims_lock = threading.Lock()
+        #: (class_name, key) -> bool existence memo shared by goal
+        #: counting and repo-miss resolution (VERDICT r04 #9): existence
+        #: depends only on the taskpool constants, so one evaluation per
+        #: distinct reference suffices for the taskpool's lifetime (GIL
+        #: makes the dict get/set safe; a racing double-compute is
+        #: idempotent)
+        self._exists_memo: Dict[Tuple[str, Tuple], bool] = {}
         for pc in ptg.classes.values():
             self.repos[pc.name] = DataRepo(nb_flows=len(pc.flows))
             self._build_class(pc)
@@ -731,7 +759,7 @@ class PTGTaskpool(Taskpool):
             for pc in self.ptg.classes.values():
                 undefined = claimed = 0
                 for loc in self._local_space(pc):
-                    if pc.goal_of(loc, self.constants) != 0:
+                    if pc.goal_of(loc, self.constants, self._exists_memo) != 0:
                         continue
                     if not self._is_startup(pc, loc, goal_known_zero=True):
                         undefined += 1
@@ -770,7 +798,7 @@ class PTGTaskpool(Taskpool):
                     continue
                 cached.append(loc)
                 pending += 1
-                if pc.goal_of(loc, self.constants) == 0:
+                if pc.goal_of(loc, self.constants, self._exists_memo) == 0:
                     if not self._is_startup(pc, loc, goal_known_zero=True):
                         undefined += 1
                     elif self._claim_source(pc.name, loc):
@@ -837,7 +865,7 @@ class PTGTaskpool(Taskpool):
         be false at enqueue time — such a task is NOT a source; its
         producer releases it later, re-evaluating the goal then.  Treating
         it as startup would execute it twice (startup + release)."""
-        if not goal_known_zero and pc.goal_of(loc, self.constants) != 0:
+        if not goal_known_zero and pc.goal_of(loc, self.constants, self._exists_memo) != 0:
             return False
         env = pc.env_of(loc, self.constants)
         for f in pc.flows:
@@ -906,7 +934,7 @@ class PTGTaskpool(Taskpool):
             # does not exist — goal_of excluded it; rare, so the
             # existence scan runs only here, off the hot path) or a real
             # asymmetric-deps bug
-            if not src_pc.instance_exists(key, self.constants):
+            if not src_pc.instance_exists(key, self.constants, self._exists_memo):
                 if f.mode & AccessMode.OUT:
                     return self._new_tile(pc, f, task)
                 return None
@@ -1035,7 +1063,7 @@ class PTGTaskpool(Taskpool):
                     self, pc.name, task.locals, rank_masks, flow_payloads)
             ready: List[Task] = []
             for succ_pc, locs in succ_list:
-                goal = succ_pc.goal_of(locs, self.constants)
+                goal = succ_pc.goal_of(locs, self.constants, self._exists_memo)
                 became, _ = self.deps.release_counter((succ_pc.name, locs), goal)
                 if became and (goal != 0
                                or self._claim_source(succ_pc.name, locs)):
@@ -1173,7 +1201,7 @@ class PTGTaskpool(Taskpool):
                                     (src_class, src_locals, f.index), payload)
                             deposited = True
                         nb_consumers += 1
-                    goal = succ_pc.goal_of(locs, self.constants)
+                    goal = succ_pc.goal_of(locs, self.constants, self._exists_memo)
                     became, _ = self.deps.release_counter(
                         (t.class_name, locs), goal)
                     if became and (goal != 0

@@ -66,16 +66,27 @@ def _block(dim: int, want: int, align: int) -> int:
 # -- matmul update ----------------------------------------------------------
 
 @functools.partial(jax.jit, static_argnames=("alpha", "transpose_b", "interpret",
-                                             "bm", "bn", "bk"))
+                                             "bm", "bn", "bk", "split_f32"))
 def matmul_update(C, A, B, *, alpha: float = -1.0, transpose_b: bool = True,
                   interpret: Optional[bool] = None,
-                  bm: int = 512, bn: int = 512, bk: int = 512):
+                  bm: int = 512, bn: int = 512, bk: int = 512,
+                  split_f32: bool = False):
     """``C + alpha * (A @ B.T)`` (or ``A @ B``) as one fused Pallas kernel.
 
     The dpotrf update bodies are exactly this shape: syrk is
     ``A - B @ B.T``, gemm is ``A - B1 @ B2.T``. Fusing the addition into
     the MXU accumulation loop writes C once instead of streaming the
     product through HBM twice.
+
+    ``split_f32`` (round-4 VERDICT #5, the fused single-pass f32
+    trailing update for getrf): each f32 operand block splits IN VMEM
+    into a (hi, lo) bfloat16 pair and the product accumulates the three
+    significant cross terms — hi*hi + hi*lo + lo*hi — at MXU bf16 rate
+    with f32 accumulation.  Numerically this IS XLA's
+    ``Precision.HIGH`` 3-pass decomposition, but as ONE kernel: the f32
+    operands cross HBM once (vs once per pass) and no pass intermediate
+    is ever materialised, so the op stays MXU-bound instead of
+    bandwidth-bound.
     """
     (m, ka) = A.shape
     if transpose_b:
@@ -104,8 +115,21 @@ def matmul_update(C, A, B, *, alpha: float = -1.0, transpose_b: bool = True,
         def _init():
             o_ref[:] = c_in_ref[:]
 
-        o_ref[:] += alpha * jnp.dot(
-            a_ref[:], b_op(b_ref[:]), preferred_element_type=o_ref.dtype)
+        a = a_ref[:]
+        b = b_op(b_ref[:])
+        if split_f32:
+            f32 = jnp.float32
+            a_hi = a.astype(jnp.bfloat16)
+            a_lo = (a - a_hi.astype(f32)).astype(jnp.bfloat16)
+            b_hi = b.astype(jnp.bfloat16)
+            b_lo = (b - b_hi.astype(f32)).astype(jnp.bfloat16)
+            prod = jnp.dot(a_hi, b_hi, preferred_element_type=f32)
+            prod += jnp.dot(a_hi, b_lo, preferred_element_type=f32)
+            prod += jnp.dot(a_lo, b_hi, preferred_element_type=f32)
+            o_ref[:] += alpha * prod
+        else:
+            o_ref[:] += alpha * jnp.dot(
+                a, b, preferred_element_type=o_ref.dtype)
 
     return pl.pallas_call(
         kernel,
@@ -119,7 +143,7 @@ def matmul_update(C, A, B, *, alpha: float = -1.0, transpose_b: bool = True,
         out_specs=pl.BlockSpec((bm_, bn_), lambda i, j, k: (i, j)),
         interpret=_auto_interpret(interpret),
         cost_estimate=pl.CostEstimate(
-            flops=2 * m * n * ka + m * n,
+            flops=(3 if split_f32 else 1) * 2 * m * n * ka + m * n,
             # per-operand dtypes: mixed-precision callers pass bf16 A/B
             # with an f32 C — half the operand traffic of all-f32
             bytes_accessed=(m * ka * A.dtype.itemsize

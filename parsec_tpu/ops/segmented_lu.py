@@ -82,12 +82,18 @@ def _pivoted_panel(A, k0: int, nb: int):
 
 
 def _make_lu_body(n: int, nb: int, strip: int, prec, kt: int, bf16=False,
-                  pivot: str = "block"):
+                  pivot: str = "block", fused_update: bool = False):
     """``bf16`` mirrors the cholesky levers (ops/segmented_chol.py):
     False = f32 3-pass trailing update; True = bf16 OPERANDS into the
     trailing gemm with f32 accumulation (ONE MXU pass instead of three —
     the update is ~all the flops); ``"storage"`` = the matrix itself
     lives in bf16 (panel math upcast to f32) — HALF the HBM traffic.
+
+    ``fused_update`` (f32 path only; round-4 VERDICT #5): the trailing
+    update runs as the fused single-kernel Pallas 3-pass
+    (``pallas_kernels.matmul_update(split_f32=True)``) — same HIGH
+    3-pass semantics, but operands cross HBM once and no pass
+    intermediate materialises.
 
     ``pivot="panel"`` replaces the block-local factorization with TRUE
     partial pivoting over the full trailing column height (LAPACK getrf
@@ -97,6 +103,9 @@ def _make_lu_body(n: int, nb: int, strip: int, prec, kt: int, bf16=False,
     store_bf16 = bf16 == "storage"
     if pivot == "panel":
         return _make_lu_body_panelpiv(n, nb, strip, prec, kt, bf16)
+    if fused_update and (store_bf16 or bf16):
+        raise ValueError("fused_update is the f32-path lever (bf16 modes "
+                         "already run one MXU pass)")
 
     def step(M, k):
         k0 = k * nb
@@ -138,6 +147,12 @@ def _make_lu_body(n: int, nb: int, strip: int, prec, kt: int, bf16=False,
             elif bf16:
                 M = M.at[k0 + nb:, c0:c0 + w].add(
                     -jnp.matmul(Lb, Ub[:, cs], preferred_element_type=f32))
+            elif fused_update:
+                from .pallas_kernels import matmul_update
+
+                M = M.at[k0 + nb:, c0:c0 + w].set(matmul_update(
+                    M[k0 + nb:, c0:c0 + w], Lp, Ur[:, cs], alpha=-1.0,
+                    transpose_b=False, split_f32=True))
             else:
                 M = M.at[k0 + nb:, c0:c0 + w].add(
                     -jnp.matmul(Lp, Ur[:, cs], precision=prec))
@@ -153,7 +168,8 @@ def _make_lu_body(n: int, nb: int, strip: int, prec, kt: int, bf16=False,
 
     panel._static_values = True
     panel._donate_args = (0,)
-    panel._jit_key = ("seglu_panel", n, nb, strip, str(prec), kt, str(bf16))
+    panel._jit_key = ("seglu_panel", n, nb, strip, str(prec), kt, str(bf16),
+                      fused_update)
     return panel
 
 
@@ -207,7 +223,7 @@ def _make_lu_body_panelpiv(n: int, nb: int, strip: int, prec, kt: int,
 
 
 def _make_lu_body_generic(n: int, nb: int, strip: int, prec, kt: int,
-                          bf16=False):
+                          bf16=False, fused_update: bool = False):
     """Parameter-generic getrf panel body: ONE compiled program for every
     k (traced scalar + ``lax.dynamic_slice``; round-3 VERDICT #3).
 
@@ -227,6 +243,9 @@ def _make_lu_body_generic(n: int, nb: int, strip: int, prec, kt: int,
     at 5x faster compile, hence the default."""
     nt = n // nb
     store_bf16 = bf16 == "storage"
+    if fused_update and (store_bf16 or bf16):
+        raise ValueError("fused_update is the f32-path lever (bf16 modes "
+                         "already run one MXU pass)")
 
     def step(k, M):
         k0 = k * nb
@@ -280,6 +299,13 @@ def _make_lu_body_generic(n: int, nb: int, strip: int, prec, kt: int,
                 Li = lax.dynamic_slice(Lb, (r0, 0), (h, nb))
                 Uj = lax.dynamic_slice(Ub, (0, c0), (nb, w))
                 T = T - jnp.matmul(Li, Uj, preferred_element_type=f32)
+            elif fused_update:
+                from .pallas_kernels import matmul_update
+
+                Li = lax.dynamic_slice(Lp, (r0, 0), (h, nb))
+                Uj = lax.dynamic_slice(Ur, (0, c0), (nb, w))
+                T = matmul_update(T, Li, Uj, alpha=-1.0,
+                                  transpose_b=False, split_f32=True)
             else:
                 Li = lax.dynamic_slice(Lp, (r0, 0), (h, nb))
                 Uj = lax.dynamic_slice(Ur, (0, c0), (nb, w))
@@ -299,14 +325,15 @@ def _make_lu_body_generic(n: int, nb: int, strip: int, prec, kt: int,
 
     panel._donate_args = (0,)
     panel._jit_key = ("seglu_panel_g", n, nb, strip, str(prec), kt,
-                      str(bf16))
+                      str(bf16), fused_update)
     return panel
 
 
 def segmented_lu_ptg(n: int, nb: int, *, strip: int = 4096,
                      prec=None, tail: int = 4096,
                      specialize: str = "generic", bf16=False,
-                     pivot: str = "block") -> PTG:
+                     pivot: str = "block",
+                     fused_update: bool = False) -> PTG:
     """Build the segmented getrf PTG (factors in place: unit-lower L
     below the diagonal, U on/above).  Instantiate with
     ``.taskpool(NT=n_segments(n, nb, tail), A=collection)``.
@@ -354,7 +381,8 @@ def segmented_lu_ptg(n: int, nb: int, *, strip: int = 4096,
         raise ValueError(f"unknown pivot mode {pivot!r}")
     make = (_make_lu_body_generic if specialize == "generic"
             else _make_lu_body)
-    panel.body(tpu=make(n, nb, strip, prec, kt, bf16=bf16))
+    panel.body(tpu=make(n, nb, strip, prec, kt, bf16=bf16,
+                        fused_update=fused_update))
     return ptg
 
 
@@ -364,7 +392,8 @@ class SegmentedLU:
 
     def __init__(self, context, n: int, nb: int, *, strip: int = 4096,
                  prec=None, tail: int = 4096, specialize: str = "generic",
-                 bf16=False, pivot: str = "block"):
+                 bf16=False, pivot: str = "block",
+                 fused_update: bool = False):
         self.context = context
         self.n, self.nb = n, nb
         self.store_bf16 = bf16 == "storage"
@@ -372,7 +401,8 @@ class SegmentedLU:
         self.nt_tasks = n_segments(n, nb, tail)
         self.ptg = segmented_lu_ptg(n, nb, strip=strip, prec=prec,
                                     tail=tail, specialize=specialize,
-                                    bf16=bf16, pivot=pivot)
+                                    bf16=bf16, pivot=pivot,
+                                    fused_update=fused_update)
         self.device = next(
             (d for d in context.devices if d.mca_name == "tpu"), None)
         if self.device is None:

@@ -2,6 +2,7 @@
 pingpong, simple_gemm shapes)."""
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -196,9 +197,13 @@ def test_ctl_arg_orders_without_passing(ctx):
     assert out.newest_copy().payload[0] == 99.0
 
 
-def test_raising_body_releases_successors(ctx):
-    """A task whose body raises must still release its successors and count
-    toward quiescence (regression: wait() used to hang)."""
+def test_raising_body_fails_pool_and_discards_successors(ctx):
+    """Round-5: a raising body fails the pool with the SAME discipline
+    as a device submit failure (reference hook-ERROR is fatal,
+    scheduling.c:512): wait() returns False promptly — no hang — the
+    successors are discarded (they would only consume the failed task's
+    stale data; the old contain-and-continue policy propagated it as a
+    'successful' run), and the context stays usable for a fresh pool."""
     d = data_create("x", payload=np.zeros(1))
     ran = []
     tp = DTDTaskpool(ctx)
@@ -212,8 +217,19 @@ def test_raising_body_releases_successors(ctx):
 
     tp.insert_task(boom, (d, INOUT))
     tp.insert_task(after, (d, INOUT))
-    assert tp.wait(timeout=30)
-    assert ran == [1]
+    assert tp.wait(timeout=30) is False  # loud: a body raised
+    assert tp.failed
+    with pytest.raises(RuntimeError):
+        tp.insert_task(after, (d, INOUT))  # failed pool rejects inserts
+    # the context survives: a fresh pool on the same data runs fine
+    tp2 = DTDTaskpool(ctx)
+    tp2.insert_task(after, (d, INOUT))
+    assert tp2.wait(timeout=30)
+    deadline = time.time() + 5
+    while not ran and time.time() < deadline:
+        time.sleep(0.01)
+    assert ran == [1]  # only the fresh pool's task ran
+    assert d.newest_copy().payload[0] == 1.0
 
 
 def test_wait_zero_timeout_polls(ctx):

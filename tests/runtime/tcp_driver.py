@@ -269,6 +269,119 @@ def scenario_dist_segchol(ce):
             "acts": int(ce.remote_dep.stats.get("activations_sent", 0))}
 
 
+def scenario_dtt_pingpong(ce):
+    """dtt_bug_replicator-class datatype regression over the REAL TCP
+    activation path (reference
+    ``/root/reference/tests/runtime/dtt_bug_replicator.jdf`` +
+    ``dtt_bug_replicator_ex.c:66-78``: the same flow ping-pongs between
+    two ranks under DIFFERENT wire datatypes — whole-tile contiguous one
+    way, a transposed/strided vector type the other).  Here each hop's
+    producer REBINDS its flow payload to an adversarial layout — PING
+    emits A as an F-order transposed view and B contiguous; PONG emits A
+    as a stride-2 embedded view and B as an F-order view — so one flow
+    carries MIXED shapes/strides across hops, through both the inline
+    and the GET wire paths (NB chosen per mode around the short limit).
+    Exact pins: activation counts, per-rank payload byte sums (from the
+    CommProfiler trace, check-comms discipline), datatype-packed sends,
+    and the final values after 2*NT-1 increments."""
+    from parsec_tpu.profiling import CommProfiler, Trace
+    from parsec_tpu.utils import mca_param
+
+    NB = int(os.environ.get("DTT_NB", "48"))
+    NT = 6
+    mca_param.set_param("runtime", "comm_short_limit", 4096)
+    tile_bytes = NB * NB * 8  # 18432 (GET path) or 2048 (inline) per hop
+    prof = CommProfiler(Trace()).install()
+    rng = np.random.default_rng(33)
+    A0 = rng.standard_normal((NB, NB))
+    B0 = rng.standard_normal((NB, NB))
+    inits = {0: A0, 1: B0, 2: np.zeros((NB, NB))}
+    ctx = Context(nb_cores=2, rank=ce.rank, nranks=ce.nranks, comm=ce)
+    try:
+        dc = LocalCollection("D", shape=(NB, NB), nodes=ce.nranks,
+                             myrank=ce.rank,
+                             init=lambda k: inits[k].copy())
+        dc.rank_of = lambda *key: 0 if dc.data_key(*key) < 2 else 1
+
+        ptg = PTG("dtt_pingpong")
+        ping = ptg.task_class("ping", k="0 .. NT-1")
+        ping.affinity("D(0)")
+        ping.flow("A", INOUT,
+                  "<- (k == 0) ? D(0) : A pong(k-1)",
+                  "-> (k < NT-1) ? A pong(k) : D(0)")
+        ping.flow("B", INOUT,
+                  "<- (k == 0) ? D(1) : B pong(k-1)",
+                  "-> (k < NT-1) ? B pong(k) : D(1)")
+
+        def ping_body(A, B, k):
+            # A leaves as a row-embedded strided view (Vector blocks=NB,
+            # blocklen=NB, stride=2*NB over a bigger base — the LAPACK
+            # panel shape, wire-packed via the datatype layer); B leaves
+            # contiguous — the DTT1 whole-tile direction
+            bigr = np.zeros((2 * NB, NB))
+            bigr[::2] = A + 1.0
+            A_out = bigr[::2]
+            assert not A_out.flags.c_contiguous
+            return A_out, B + 1.0
+
+        ping.body(cpu=ping_body)
+
+        pong = ptg.task_class("pong", k="0 .. NT-2")
+        pong.affinity("D(2)")
+        pong.flow("A", INOUT, "<- A ping(k)", "-> A ping(k+1)")
+        pong.flow("B", INOUT, "<- B ping(k)", "-> B ping(k+1)")
+
+        def pong_body(A, B, k):
+            # A leaves as a column stride-2 embedded view (non-unit inner
+            # stride: the vector-of-single-elements DTT2 analog, gathered
+            # by the wire's fallback path); B as an F-CONTIGUOUS array
+            # (ships as-is — order preservation is part of the pin)
+            big = np.zeros((NB, 2 * NB))
+            big[:, ::2] = A + 1.0
+            A_out = big[:, ::2]
+            assert not A_out.flags.c_contiguous
+            B_out = np.asfortranarray(B + 1.0)
+            assert B_out.flags.f_contiguous and not B_out.flags.c_contiguous
+            return A_out, B_out
+
+        pong.body(cpu=pong_body)
+        tp = ptg.taskpool(NT=NT, D=dc)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=90), "dtt pingpong did not quiesce"
+        ce.barrier()
+
+        # every hop's increment survived every layout change: the final
+        # home tiles hold exactly A0/B0 + (2*NT - 1)
+        if ce.rank == 0:
+            for key, base in ((0, A0), (1, B0)):
+                out = np.asarray(dc.data_of(key).newest_copy().payload)
+                np.testing.assert_allclose(out, base + (2 * NT - 1),
+                                           rtol=0, atol=1e-12)
+
+        df = prof.trace.to_dataframe()
+        act = df[df["name"] == "MPI_ACTIVATE"]
+        pld = df[df["name"] == "MPI_DATA_PLD"]
+        # exact pins, receiver side: every inbound activation carries
+        # both flows' payloads of exactly NB*NB*8 bytes each (nbytes
+        # counts DATA, not the strided extent — a layout leak would
+        # break the sum)
+        n_in = NT - 1
+        assert len(pld) == 2 * n_in, (len(pld), n_in)
+        assert int(pld["bytes"].sum()) == 2 * n_in * tile_bytes
+        assert len(act) == n_in, len(act)
+        sent = int(ctx.comm.remote_dep.stats["activations_sent"])
+        assert sent == n_in, sent
+        # the adversarial layouts really crossed the datatype packer
+        packed = int(ce.stats.get("dt_packed", 0))
+        assert packed >= n_in, packed
+        return {"pld_bytes": int(pld["bytes"].sum()),
+                "pld_kinds": sorted(set(pld["kind"])),
+                "dt_packed": packed}
+    finally:
+        ctx.fini()
+        prof.uninstall()
+
+
 def main():
     scenario = sys.argv[1]
     ce = endpoint_from_env()

@@ -253,3 +253,42 @@ def test_insert_into_aborted_dtd_pool_rejected():
             tp.insert_task(lambda x: None, (d, INOUT))
     finally:
         ctx.fini()
+
+
+def test_raising_body_fails_pool_loudly():
+    """Round-5: a CPU body that raises must FAIL the pool — wait()
+    returns False (reference hook-ERROR is fatal, scheduling.c:512; the
+    device-submit path got this discipline in round 4).  Successors
+    still release and retire, so the pool quiesces promptly instead of
+    hanging — but a run that propagated a failed task's stale data can
+    no longer report success.  Found by the dtt_pingpong port: a raising
+    ping body silently forwarded its un-incremented input for six hops
+    and the chain 'passed'."""
+    import numpy as np
+
+    from parsec_tpu.data import LocalCollection
+    from parsec_tpu.dsl.ptg import PTG, INOUT
+
+    ran = []
+    with Context(nb_cores=2) as ctx:
+        dc = LocalCollection("D", shape=(4,), dtype=np.float64)
+        ptg = PTG("failchain")
+        step = ptg.task_class("step", k="0 .. 3")
+        step.affinity("D(0)")
+        step.flow("X", INOUT,
+                  "<- (k == 0) ? D(0) : X step(k-1)",
+                  "-> (k < 3) ? X step(k+1) : D(0)")
+
+        def body(X, k):
+            ran.append(k)
+            if k == 1:
+                raise RuntimeError("injected body failure")
+            X += 1.0
+
+        step.body(cpu=body)
+        tp = ptg.taskpool(D=dc)
+        ctx.add_taskpool(tp)
+        # quiesces (successors still released, counters drained)...
+        assert tp.wait(timeout=30) is False  # ...but reports the failure
+        assert tp.failed
+        assert 1 in ran

@@ -26,14 +26,18 @@ def test_dist_segmented_cholesky_4ranks():
                     reason="binary tracer needs the native core")
 def test_dist_segmented_cholesky_8ranks_overlap():
     """The 8-rank artifact: overlap fraction from binary traces at the
-    dryrun mesh scale.  The fraction itself is workload/host dependent —
-    the pinned facts are that comm events exist, compute spans exist,
-    and the fraction is well-defined; the measured value is recorded in
-    BASELINE.md."""
+    dryrun mesh scale.  The fraction is workload/host dependent, but an
+    un-falsifiable [0, 1] check is no evidence (round-4 VERDICT Weak #2):
+    this config measured 0.91 on the round-4 host and 0.55 at the smaller
+    dryrun config, so 0.3 is a floor with real margin — a scheduler or
+    tracer regression that serializes comm against compute lands below
+    it."""
     err, stats = run_dist_segmented_cholesky(8, 512, 64, trace_pins=True)
     assert err < 1e-3, err
     assert stats["n_comm_events"] > 0
     assert stats["busy_us"] > 0
-    assert 0.0 <= stats["overlap_fraction"] <= 1.0
+    assert stats["overlap_fraction"] >= 0.3, (
+        f"comm/compute overlap collapsed: {stats['overlap_fraction']:.2f} "
+        f"over {stats['n_comm_events']} comm events")
     print(f"8-rank overlap fraction: {stats['overlap_fraction']:.2f} "
           f"({stats['n_comm_events']} comm events)")

@@ -140,3 +140,25 @@ def test_tcp_dist_segchol_2ranks():
     assert all(o["err"] < 1e-3 for o in out), out
     # panel broadcasts really crossed the wire from every rank
     assert sum(o["acts"] for o in out) > 0
+
+
+@pytest.mark.parametrize("nb,kinds", [
+    (48, ["get"]),      # 18432-B tiles: every payload takes the GET path
+    (16, ["inline"]),   # 2048-B tiles: everything inlines
+])
+def test_tcp_dtt_pingpong_mixed_layouts(nb, kinds):
+    """dtt_bug_replicator-class regression (reference
+    tests/runtime/dtt_bug_replicator.jdf): one flow ping-pongs between
+    two real processes while each hop rebinds the payload to a different
+    layout (F-order transposed view, stride-2 embedded view, contiguous)
+    — values must survive exactly, and the per-rank payload byte sums,
+    activation counts and datatype-packed sends are pinned in the
+    scenario.  Parametrized around the short limit so BOTH wire paths
+    (one-sided GET and inline) carry the adversarial layouts."""
+    out = run_scenario("dtt_pingpong", 2, timeout=300,
+                       extra_env={"DTT_NB": str(nb)})
+    NT, tile = 6, nb * nb * 8
+    # receiver-side byte sums: each rank took NT-1 activations of 2
+    # payloads each (the scenario already pinned its own side exactly)
+    assert all(o["pld_bytes"] == 2 * (NT - 1) * tile for o in out), out
+    assert all(o["pld_kinds"] == kinds for o in out), out

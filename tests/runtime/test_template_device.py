@@ -45,6 +45,8 @@ def test_wrong_output_count_is_reported():
         # two writable flows, body returns one value
         tp.insert_task({DEV_TEMPLATE: lambda x, y: x + 1.0},
                        (d1, INOUT), (d2, INOUT))
-        assert tp.wait(timeout=30)  # error contained, taskpool completes
+        # quiesces, but the body error FAILS the pool (round-5 loudness)
+        assert tp.wait(timeout=30) is False
+        assert tp.failed
     finally:
         ctx.fini()
