@@ -150,6 +150,19 @@ class TpuDevice(Device):
         self._eager = bool(mca_param.register(
             "device", "tpu_eager_complete", 1,
             help="complete device tasks at dispatch; 0 = poll lane events"))
+        #: wave batching (round-4 VERDICT #6): when the manager drains a
+        #: ready wave of same-class tasks (same body, same arg signature,
+        #: no donation/static-values/custom staging), submit the whole
+        #: wave as ONE jitted multi-body program — one device enqueue RPC
+        #: per wave instead of one per task (the reference amortizes via
+        #: per-stream in-order queues, device_gpu.c:1879-1999; a
+        #: host-tunneled PJRT pays per-enqueue latency instead).  Waves
+        #: decompose into power-of-2 chunks so the compile cache stays
+        #: bounded.  Value = minimum group size; 0 disables.
+        self._wave_min = mca_param.register(
+            "device", "tpu_wave_batch", 2,
+            help="min same-signature ready-wave size batched into one "
+                 "program (0 disables wave batching)")
         #: dual LRU of resident Data keyed by data_id (reference
         #: gpu_mem_lru / gpu_mem_owned_lru)
         self._lru_clean: "collections.OrderedDict[int, Data]" = collections.OrderedDict()
@@ -218,55 +231,54 @@ class TpuDevice(Device):
         return HookReturn.ASYNC  # completions were issued by the manager
 
     def _manager_loop(self, es) -> None:
-        # phase: check_in_deps + exec — submit everything pending
+        # phase: check_in_deps + exec — submit everything pending.
+        # The drained batch is grouped into same-signature WAVES first
+        # (one jitted multi-body program per wave — one enqueue RPC
+        # instead of one per task); everything else goes per-task.
         while True:
-            while True:
-                with self._lock:
-                    task = self._pending.popleft() if self._pending else None
-                if task is None:
-                    break
+            drained: List[Task] = []
+            with self._lock:
+                while self._pending:
+                    drained.append(self._pending.popleft())
+            # one O(n) bucketing pass: signature computed ONCE per task,
+            # waves emitted in arrival order of their first member
+            units: List[Tuple[str, Any]] = []
+            buckets: Dict[Any, List[Task]] = {}
+            for task in drained:
                 if getattr(task.taskpool, "failed", False):
                     continue  # pool already failed: discard, never execute
-                try:
-                    self._submit(task, es)
-                except Exception as e:
-                    debug.error("tpu submit of %r failed: %s", task, e)
-                    import traceback
-
-                    traceback.print_exc()
-                    # eager _submit may have begun releasing successors
-                    # before raising — retrying or completing again would
-                    # double-release dependency counters: fail the pool
-                    if getattr(task, "_tpu_completed", False):
-                        self._fail_task_pool(
-                            task, f"device epilog/completion raised: {e!r}")
+                sig = (self._wave_signature(task)
+                       if self._wave_min > 0 else None)
+                if sig is None:
+                    units.append(("single", task))
+                    continue
+                key = (id(task.taskpool), sig)
+                group = buckets.get(key)
+                if group is None:
+                    group = buckets[key] = []
+                    units.append(("wave", group))
+                group.append(task)
+            for kind, item in units:
+                if kind == "single":
+                    self._submit_one(item, es)
+                    continue
+                group = item
+                if len(group) >= max(2, self._wave_min):
+                    try:
+                        self._submit_wave(group, es)
                         continue
-                    # one retry with fresh state: a transient PJRT/tunnel
-                    # RPC error must not zero a run (_submit re-stages
-                    # inputs from the newest valid copies, so the retry
-                    # starts clean).  ONLY when the first attempt provably
-                    # had no side effects — a partially-committed epilog
-                    # (some output tiles rebound + version-bumped) or a
-                    # donated input buffer would make the retry
-                    # double-apply INOUT updates: silent corruption, the
-                    # exact mode this path exists to eliminate.
-                    attempts = getattr(task, "_tpu_attempts", 0) + 1
-                    task._tpu_attempts = attempts
-                    if attempts == 1 and not getattr(task, "_tpu_effects",
-                                                     False):
-                        debug.warning("retrying device submit of %r", task)
-                        with self._lock:
-                            self._pending.append(task)
-                        continue
-                    # retry failed too: completing the task anyway would
-                    # hand successors a garbage placeholder and the pool
-                    # would quiesce "successfully" with wrong numerics —
-                    # the worst failure mode a runtime can have (reference
-                    # treats hook ERROR as fatal, scheduling.c:512).  Fail
-                    # the pool: wait() returns False, successors stay
-                    # unreleased.
-                    self._fail_task_pool(
-                        task, f"device submit failed after retry: {e!r}")
+                    except Exception as e:
+                        # only pre-dispatch failures escape _submit_wave
+                        # (staging/trace/enqueue — no task side effects
+                        # yet); per-task epilog/completion errors are
+                        # contained inside it with a loud pool fail
+                        debug.warning(
+                            "wave submit of %d tasks failed (%s); "
+                            "falling back per-task", len(group), e)
+                for t in group:
+                    if not getattr(t, "_tpu_completed", False) \
+                            and not getattr(t.taskpool, "failed", False):
+                        self._submit_one(t, es)
             # phase: get_data_out — retire ready computations in order
             progressed = self._poll_lanes(es)
             with self._lock:
@@ -282,6 +294,49 @@ class TpuDevice(Device):
                         oldest.outputs[0].block_until_ready()
                     except Exception:
                         pass
+
+    def _submit_one(self, task: Task, es) -> None:
+        """Per-task submit with the retry/fail-loudly discipline."""
+        try:
+            self._submit(task, es)
+        except Exception as e:
+            debug.error("tpu submit of %r failed: %s", task, e)
+            import traceback
+
+            traceback.print_exc()
+            # eager _submit may have begun releasing successors
+            # before raising — retrying or completing again would
+            # double-release dependency counters: fail the pool
+            if getattr(task, "_tpu_completed", False):
+                self._fail_task_pool(
+                    task, f"device epilog/completion raised: {e!r}")
+                return
+            # one retry with fresh state: a transient PJRT/tunnel
+            # RPC error must not zero a run (_submit re-stages
+            # inputs from the newest valid copies, so the retry
+            # starts clean).  ONLY when the first attempt provably
+            # had no side effects — a partially-committed epilog
+            # (some output tiles rebound + version-bumped) or a
+            # donated input buffer would make the retry
+            # double-apply INOUT updates: silent corruption, the
+            # exact mode this path exists to eliminate.
+            attempts = getattr(task, "_tpu_attempts", 0) + 1
+            task._tpu_attempts = attempts
+            if attempts == 1 and not getattr(task, "_tpu_effects",
+                                             False):
+                debug.warning("retrying device submit of %r", task)
+                with self._lock:
+                    self._pending.append(task)
+                return
+            # retry failed too: completing the task anyway would
+            # hand successors a garbage placeholder and the pool
+            # would quiesce "successfully" with wrong numerics —
+            # the worst failure mode a runtime can have (reference
+            # treats hook ERROR as fatal, scheduling.c:512).  Fail
+            # the pool: wait() returns False, successors stay
+            # unreleased.
+            self._fail_task_pool(
+                task, f"device submit failed after retry: {e!r}")
 
     def _fail_task_pool(self, task: Task, why: str) -> None:
         """Device execution failed unrecoverably: fail the task's pool so
@@ -302,13 +357,123 @@ class TpuDevice(Device):
     # ------------------------------------------------------------------
     # stage_in / submit
     # ------------------------------------------------------------------
-    def _submit(self, task: Task, es=None) -> None:
-        """kernel_push + body dispatch (reference device_gpu.c:2015-2164)."""
-        body = task.selected_chore.body_fn
-        if body is None:
-            # DTD/PTG store the raw device body on the chore at build time
-            raise RuntimeError(f"chore of {task!r} has no body_fn for device execution")
+    def _wave_signature(self, task: Task):
+        """Hashable batching signature, or None when the task cannot ride
+        a wave: bodies with baked static values (per-task traces),
+        donation (aliasing across a shared program is unsafe), or custom
+        staging hooks are excluded; data args must have knowable shapes.
+        Two tasks with equal signatures trace identically through the
+        shared wave program."""
+        body = task.selected_chore.body_fn if task.selected_chore else None
+        if body is None or getattr(body, "_static_values", False) \
+                or getattr(body, "_donate_args", None) \
+                or getattr(body, "_stage_in", None) \
+                or getattr(body, "_stage_out", None):
+            return None
+        sig: List[Any] = [getattr(body, "_jit_key", None) or id(body)]
+        for kind, payload, mode in (task.body_args or ()):
+            if kind == "data":
+                if payload is None:
+                    sig.append(("none",))
+                    continue
+                shape, dtype = payload.shape, payload.dtype
+                if shape is None or dtype is None:
+                    newest = payload.newest_copy()
+                    p = getattr(newest, "payload", None)
+                    shape = getattr(p, "shape", None)
+                    dtype = getattr(p, "dtype", None)
+                if shape is None or dtype is None:
+                    return None
+                sig.append(("data", tuple(shape), str(dtype), int(mode)))
+            elif kind == "value":
+                # traced runtime arg: the TYPE shapes the trace
+                sig.append(("value", type(payload).__name__))
+            elif kind == "scratch":
+                sig.append(("scratch", tuple(payload[0]), str(payload[1])))
+            else:
+                sig.append((kind,))
+        return tuple(sig)
 
+    def _submit_wave(self, tasks: List[Task], es) -> None:
+        """Submit a same-signature ready wave as one (or a few
+        power-of-2) jitted multi-body programs: ONE device enqueue per
+        chunk instead of one per task (round-4 VERDICT #6).
+
+        Failure containment: staging/trace/enqueue errors RAISE before
+        any task has side effects — the manager's per-task fallback is
+        safe (functional bodies, no donation).  Once a task's epilog
+        begins, errors are contained HERE with a loud pool fail (the
+        same discipline as ``_submit_one``'s completed branch): a
+        half-committed task must be neither retried (double-apply) nor
+        silently skipped (wait() would hang to timeout)."""
+        from ..core import scheduling
+
+        body = tasks[0].selected_chore.body_fn
+        staged = [self._stage_task_args(t, body) for t in tasks]
+        arity = len(staged[0][0])
+        nout = len(staged[0][1])
+        base_key = getattr(body, "_jit_key", None) or id(body)
+        start = 0
+        remaining = len(tasks)
+        while remaining:
+            cnt = 1 << (remaining.bit_length() - 1)  # largest pow2 chunk
+            grp = tasks[start:start + cnt]
+            gst = staged[start:start + cnt]
+            start += cnt
+            remaining -= cnt
+            key = ("wave", base_key, arity, nout, cnt)
+            jitted = self._jit_cache.get(key)
+            if jitted is None:
+                def _wave(*flat, _body=body, _arity=arity, _cnt=cnt):
+                    outs: List[Any] = []
+                    for t in range(_cnt):
+                        o = _body(*flat[t * _arity:(t + 1) * _arity])
+                        outs.extend(o if isinstance(o, (tuple, list))
+                                    else (o,))
+                    return tuple(outs)
+                jitted = self._jit_cache[key] = jax.jit(_wave)
+            flat = [a for (dargs, _, _) in gst for a in dargs]
+            outs = jitted(*flat)
+            if len(outs) != nout * cnt:
+                raise ValueError(
+                    f"wave of {tasks[0].task_class.name}: bodies returned "
+                    f"{len(outs)} outputs for {nout * cnt} writable flows")
+            self.stats["wave_submits"] = self.stats.get("wave_submits",
+                                                        0) + 1
+            self.stats["wave_tasks"] = self.stats.get("wave_tasks",
+                                                      0) + cnt
+            pos = 0
+            for task, (dargs, ospecs, ohooks) in zip(grp, gst):
+                inflight = _InFlight(task, list(outs[pos:pos + nout]),
+                                     ospecs, ohooks)
+                pos += nout
+                if getattr(task.taskpool, "failed", False):
+                    continue  # a sibling's failure already took the pool
+                if self._eager:
+                    task._tpu_effects = True
+                    try:
+                        self._epilog(inflight)
+                        task._tpu_completed = True
+                        scheduling.complete_execution(self.context, es,
+                                                      task)
+                    except Exception as e:
+                        debug.error("wave epilog/completion of %r "
+                                    "failed: %s", task, e)
+                        self._fail_task_pool(
+                            task,
+                            f"device epilog/completion raised: {e!r}")
+                        task._tpu_completed = True  # never resubmit
+                else:
+                    lane = self._lanes[self._rr % self._nlanes]
+                    self._rr += 1
+                    lane.append(inflight)
+                    task._tpu_completed = True  # owned by the lane now
+
+    def _stage_task_args(self, task: Task, body):
+        """kernel_push: stage every flow of ``task`` onto this device and
+        return ``(dev_args, out_specs, out_hooks)`` (reference
+        device_gpu.c:2015-2164 stage-in phase, factored out so the wave
+        path shares it)."""
         # per-flow custom staging (reference stage_in/stage_out device
         # hooks, device_gpu.h:62-94), keyed by data-arg order
         si_hooks = getattr(body, "_stage_in", None) or {}
@@ -355,6 +520,15 @@ class TpuDevice(Device):
                 shape, dtype = payload
                 dev_args.append(jax.device_put(jnp.zeros(shape, dtype), self.jdev))
             # other kinds (e.g. "ctl") contribute no argument
+        return dev_args, out_specs, out_hooks
+
+    def _submit(self, task: Task, es=None) -> None:
+        """Stage + body dispatch (reference device_gpu.c:2015-2164)."""
+        body = task.selected_chore.body_fn
+        if body is None:
+            # DTD/PTG store the raw device body on the chore at build time
+            raise RuntimeError(f"chore of {task!r} has no body_fn for device execution")
+        dev_args, out_specs, out_hooks = self._stage_task_args(task, body)
 
         base_key = getattr(body, "_jit_key", body)
         # opt-in body attributes (set by the DSL body author):

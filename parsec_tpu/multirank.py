@@ -1,0 +1,108 @@
+"""Shared multi-rank run harness over the in-process fabric.
+
+One rank = one full :class:`~parsec_tpu.core.context.Context` (own
+scheduler/workers/devices) talking to its peers only through the comm
+engine — the same "multi-node is multi-process on one node" testing
+shape the reference uses (``SURVEY.md §4``, mpiexec on one host).  The
+round-5 review found three near-identical copies of this harness
+(distributed segmented cholesky, the dryrun dpotrf/stencil perf rows);
+this is the single implementation they share, including the perf-row
+bookkeeping (wall clock, executed tasks, activation counts, optional
+comm/compute overlap via :func:`parsec_tpu.profiling.overlap.measure_overlap`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["run_multirank_perf"]
+
+
+def run_multirank_perf(
+    nranks: int,
+    build: Callable[[int, Any], Tuple[Any, Any]],
+    *,
+    nb_cores: int = 2,
+    timeout: float = 600,
+    fabric=None,
+    overlap: bool = False,
+    flops: Optional[float] = None,
+) -> Tuple[List[Any], Dict]:
+    """Run one taskpool per rank to quiescence and return perf stats.
+
+    ``build(rank, ctx) -> (taskpool, user)`` constructs each rank's
+    taskpool (and any per-rank object the caller needs back — a data
+    collection, usually).  Returns ``(users, stats)`` where ``stats``
+    carries ``wall_s`` / ``executed_tasks`` / ``tasks_per_s`` /
+    ``activations`` (+ ``gflops`` when ``flops`` is given, computed as
+    flops/wall — the *aggregate* figure a SYNC_TIME_PRINT row reports)
+    and, with ``overlap=True`` on a native-enabled build, the
+    ``overlap_fraction`` / ``n_comm_events`` / ``busy_us`` trio.
+
+    Raises on any rank error or failed quiescence — after every context
+    is finalized, so a failure cannot leak worker threads.  The returned
+    ``users`` objects stay readable after fini (tiles outlive contexts).
+    """
+    from . import Context, native
+    from .comm import InprocFabric
+
+    stats: Dict = {}
+    if overlap and native.available():
+        from .profiling.overlap import measure_overlap
+
+        scope = measure_overlap(stats)
+    else:
+        scope = contextlib.nullcontext()
+
+    with scope:
+        fabric = fabric or InprocFabric(nranks)
+        ces = fabric.endpoints()
+        ctxs = [Context(nb_cores=nb_cores, rank=r, nranks=nranks,
+                        comm=ces[r])
+                for r in range(nranks)]
+        users: List[Any] = [None] * nranks
+        oks: List[Any] = [False] * nranks
+        errs: List[Tuple[int, BaseException]] = []
+
+        def worker(r):
+            try:
+                tp, users[r] = build(r, ctxs[r])
+                ctxs[r].add_taskpool(tp)
+                oks[r] = tp.wait(timeout=timeout)
+            except BaseException as e:  # surfaced after join
+                errs.append((r, e))
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker, args=(r,))
+                   for r in range(nranks)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout + 30)
+        stats["wall_s"] = time.perf_counter() - t0
+
+        try:
+            if errs:
+                raise RuntimeError(f"rank errors: {errs}")
+            if not all(oks):
+                raise RuntimeError(f"ranks failed to quiesce: {oks}")
+            execd = sum(d.stats["executed_tasks"]
+                        for c in ctxs for d in c.devices)
+            stats["executed_tasks"] = execd
+            stats["tasks_per_s"] = round(
+                execd / max(stats["wall_s"], 1e-9), 1)
+            stats["activations"] = sum(
+                c.comm.remote_dep.stats["activations_sent"] for c in ctxs)
+            stats["bytes_d2d"] = sum(
+                d.stats.get("bytes_d2d", 0)
+                for c in ctxs for d in c.devices)
+            if flops is not None:
+                stats["gflops"] = round(
+                    flops / max(stats["wall_s"], 1e-9) / 1e9, 3)
+        finally:
+            for c in ctxs:
+                c.fini()
+    return users, stats

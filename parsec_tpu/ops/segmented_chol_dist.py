@@ -174,114 +174,37 @@ def run_dist_segmented_cholesky(nranks: int, n: int, nb: int, *,
     the multi-rank north-star artifact for dryrun/tests.  Returns
     ``(err, stats_dict)``; with ``trace_pins`` the comm/compute overlap
     fraction from the native binary tracer is included."""
-    import threading
-
-    from .. import Context
-    from ..comm.inproc import InprocFabric
     from ..data import LocalCollection
+    from ..multirank import run_multirank_perf
 
     rng = np.random.default_rng(seed)
     m = rng.standard_normal((n, n)).astype(dtype)
     SPD = m @ m.T + n * np.eye(n, dtype=dtype)
     NT = n // nb
 
-    prof = None
-    subs = []
-    if trace_pins:
-        from ..profiling import pins
-        from ..profiling.binary import BinaryTaskProfiler
+    def build(r, ctx):
+        dc = LocalCollection(
+            "C", shape=(n, nb), dtype=dtype, nodes=nranks, myrank=r,
+            init=lambda j: np.ascontiguousarray(
+                SPD[:, j * nb:(j + 1) * nb]))
+        dc.rank_of = lambda j: j % nranks
+        tp = dist_segmented_cholesky_ptg(n, nb).taskpool(
+            NT=NT, C=dc, TILE_SHAPE=(n, nb), TILE_DTYPE=dtype)
+        return tp, dc
 
-        prof = BinaryTaskProfiler()
-        k_send = prof.trace.keyword("comm_send")
-        k_recv = prof.trace.keyword("comm_recv")
-        for site, cb in ((pins.COMM_ACTIVATE,
-                          lambda es, info: prof.trace.instant(k_send)),
-                         (pins.COMM_DATA_PLD,
-                          lambda es, info: prof.trace.instant(k_recv))):
-            pins.subscribe(site, cb)
-            subs.append((site, cb))
-
-    fabric = fabric or InprocFabric(nranks)
-    ces = fabric.endpoints()
-    ctxs = [Context(nb_cores=nb_cores, rank=r, nranks=nranks, comm=ces[r])
-            for r in range(nranks)]
-    cols, oks, errs = {}, [False] * nranks, []
-
-    def worker(r):
-        try:
-            dc = LocalCollection(
-                "C", shape=(n, nb), dtype=dtype, nodes=nranks, myrank=r,
-                init=lambda j: np.ascontiguousarray(
-                    SPD[:, j * nb:(j + 1) * nb]))
-            dc.rank_of = lambda j: j % nranks
-            cols[r] = dc
-            tp = dist_segmented_cholesky_ptg(n, nb).taskpool(
-                NT=NT, C=dc, TILE_SHAPE=(n, nb), TILE_DTYPE=dtype)
-            ctxs[r].add_taskpool(tp)
-            oks[r] = tp.wait(timeout=timeout)
-        except Exception as e:  # surfaced by the caller
-            errs.append((r, e))
-
-    threads = [threading.Thread(target=worker, args=(r,))
-               for r in range(nranks)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(timeout=timeout + 30)
-
-    stats: dict = {}
-    try:
-        if errs:
-            raise RuntimeError(f"rank errors: {errs}")
-        if not all(oks):
-            raise RuntimeError(f"ranks failed to quiesce: {oks}")
-        out = np.zeros((n, n), dtype)
-        execd = 0
-        d2d = 0
-        for r, dc in cols.items():
-            # count across ALL devices: at scale the selector may route
-            # some tasks to the CPU fallback, and a tpu-only count would
-            # silently undercount (bytes_d2d is simply 0 off-device)
-            execd += sum(d.stats["executed_tasks"] for d in ctxs[r].devices)
-            d2d += sum(d.stats.get("bytes_d2d", 0) for d in ctxs[r].devices)
-            for j in range(NT):
-                if j % nranks != r:
-                    continue
-                c = dc.data_of(j).newest_copy()
-                out[:, j * nb:(j + 1) * nb] = np.asarray(c.payload)
-        stats["executed_tasks"] = execd
-        stats["bytes_d2d"] = d2d
-        stats["activations"] = sum(
-            c.comm.remote_dep.stats["activations_sent"] for c in ctxs)
-        ref = np.linalg.cholesky(SPD.astype(np.float64))
-        err = float(np.abs(np.tril(out).astype(np.float64) - ref).max()
-                    / np.abs(ref).max())
-    finally:
-        for c in ctxs:
-            c.fini()
-        if prof is not None:
-            from ..profiling import pins
-
-            for site, cb in subs:
-                pins.unsubscribe(site, cb)
-            prof.uninstall()
-
-    if prof is not None:
-        import os
-        import tempfile
-
-        from ..profiling.binary import to_chrome_events
-        from ..profiling.tools import comm_overlap_fraction
-
-        fd, path = tempfile.mkstemp(suffix=".pbt")
-        os.close(fd)
-        try:
-            prof.trace.dump(path)
-            frac, n_comm, busy_us = comm_overlap_fraction(
-                to_chrome_events(path))
-            stats["overlap_fraction"] = frac
-            stats["n_comm_events"] = n_comm
-            stats["busy_us"] = busy_us
-        finally:
-            os.unlink(path)
+    # gflops = USEFUL dpotrf flops (n^3/3); the full-height formulation
+    # executes more raw flops — this is the comparable figure
+    cols, stats = run_multirank_perf(
+        nranks, build, nb_cores=nb_cores, timeout=timeout, fabric=fabric,
+        overlap=trace_pins, flops=n**3 / 3)
+    out = np.zeros((n, n), dtype)
+    for r, dc in enumerate(cols):
+        for j in range(NT):
+            if j % nranks != r:
+                continue
+            c = dc.data_of(j).newest_copy()
+            out[:, j * nb:(j + 1) * nb] = np.asarray(c.payload)
+    ref = np.linalg.cholesky(SPD.astype(np.float64))
+    err = float(np.abs(np.tril(out).astype(np.float64) - ref).max()
+                / np.abs(ref).max())
     return err, stats

@@ -128,10 +128,15 @@ def _make_lu_body(n: int, nb: int, strip: int, prec, kt: int, bf16=False,
             (jnp.triu(U_D) + jnp.tril(L_D, -1)).astype(M.dtype))
         if k0 + nb >= n:
             return M
+        # panel/row solves at ``prec`` (HIGH, 3-pass), not HIGHEST: the
+        # two full-extent solve gemms cost ~as much MXU time as the whole
+        # trailing update when run 6-pass — the round-5 profile showed
+        # they, not the update, bound f32 getrf (measured err stays
+        # f32-class: products against nb x nb inverse factors)
         Lp = jnp.matmul(M[k0 + nb:, k0:k0 + nb].astype(f32), invU,
-                        precision=hi)
+                        precision=prec)
         Ur = jnp.matmul(invL, M[k0:k0 + nb, k0 + nb:].astype(f32),
-                        precision=hi)
+                        precision=prec)
         M = M.at[k0 + nb:, k0:k0 + nb].set(Lp.astype(M.dtype))
         M = M.at[k0:k0 + nb, k0 + nb:].set(Ur.astype(M.dtype))
         if store_bf16 or bf16:
@@ -267,11 +272,13 @@ def _make_lu_body_generic(n: int, nb: int, strip: int, prec, kt: int,
         M = lax.dynamic_update_slice(
             M, (jnp.triu(U_D) + jnp.tril(L_D, -1)).astype(M.dtype),
             (k0, k0))
-        # full-extent solves; only the [k0+nb, n) part is ever stored
+        # full-extent solves; only the [k0+nb, n) part is ever stored.
+        # ``prec`` (3-pass), not HIGHEST: see the static body's note —
+        # these two gemms otherwise cost ~the whole trailing update
         C = lax.dynamic_slice(M, (0, k0), (n, nb)).astype(f32)
-        Lp = jnp.matmul(C, invU, precision=hi)        # rows >= k0+nb valid
+        Lp = jnp.matmul(C, invU, precision=prec)      # rows >= k0+nb valid
         Rw = lax.dynamic_slice(M, (k0, 0), (nb, n)).astype(f32)
-        Ur = jnp.matmul(invL, Rw, precision=hi)       # cols >= k0+nb valid
+        Ur = jnp.matmul(invL, Rw, precision=prec)     # cols >= k0+nb valid
         if store_bf16 or bf16:
             Lb, Ub = Lp.astype(jnp.bfloat16), Ur.astype(jnp.bfloat16)
 

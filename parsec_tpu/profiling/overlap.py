@@ -1,0 +1,58 @@
+"""Comm/compute overlap measurement as a reusable scope.
+
+The reference's stencil study measures how much communication hides
+under compute (BASELINE.json config #5; ``remote_dep.c:320-345`` routes
+the broadcasts whose latency is being hidden).  This module packages the
+metric pipeline the round-3/4 artifacts used ad hoc — subscribe the comm
+PINS sites to a native binary trace, dump, convert, and compute the
+fraction of comm events that land while a compute span is active — so
+the dryrun, tests, and apps measure overlap identically.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import tempfile
+from typing import Dict, Iterator
+
+
+@contextlib.contextmanager
+def measure_overlap(stats: Dict) -> Iterator[Dict]:
+    """Context manager: record comm instants (COMM_ACTIVATE send,
+    COMM_DATA_PLD receive) and task exec spans via the native binary
+    tracer for everything run inside the scope; on exit merge
+    ``overlap_fraction`` / ``n_comm_events`` / ``busy_us`` into
+    ``stats``.  Requires the native core (callers gate on
+    ``parsec_tpu.native.available()``)."""
+    from . import pins
+    from .binary import BinaryTaskProfiler, to_chrome_events
+    from .tools import comm_overlap_fraction
+
+    prof = BinaryTaskProfiler()
+    k_send = prof.trace.keyword("comm_send")
+    k_recv = prof.trace.keyword("comm_recv")
+    subs = []
+    for site, cb in ((pins.COMM_ACTIVATE,
+                      lambda es, info: prof.trace.instant(k_send)),
+                     (pins.COMM_DATA_PLD,
+                      lambda es, info: prof.trace.instant(k_recv))):
+        pins.subscribe(site, cb)
+        subs.append((site, cb))
+    try:
+        yield stats
+    finally:
+        for site, cb in subs:
+            pins.unsubscribe(site, cb)
+        prof.uninstall()
+        fd, path = tempfile.mkstemp(suffix=".pbt")
+        os.close(fd)
+        try:
+            prof.trace.dump(path)
+            frac, n_comm, busy_us = comm_overlap_fraction(
+                to_chrome_events(path))
+            stats["overlap_fraction"] = frac
+            stats["n_comm_events"] = n_comm
+            stats["busy_us"] = busy_us
+        finally:
+            os.unlink(path)
