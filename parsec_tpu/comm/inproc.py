@@ -20,6 +20,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..profiling import pins
 from ..utils import debug, register_component
 from .engine import CommEngine, MAX_AM_TAGS
 
@@ -91,10 +92,23 @@ class InprocComm(CommEngine):
 
     def send_am(self, tag: int, dst_rank: int, payload: Any) -> None:
         self.stats[f"am_sent_{tag}"] += 1
-        self.stats["am_bytes"] += _payload_bytes(payload)
+        nbytes = _payload_bytes(payload)
+        self.stats["am_bytes"] += nbytes
         self._termdet_note_sent(tag)
+        # transport span: bytes + peer + receiver queue depth measured AT
+        # the wire (per-rank tracing routes on the ``rank`` field)
+        wire = pins.active(pins.COMM_SEND_BEGIN)
+        if wire:
+            pins.fire(pins.COMM_SEND_BEGIN, None,
+                      {"rank": self.rank, "peer": dst_rank, "tag": tag,
+                       "bytes": nbytes,
+                       "qdepth": self.fabric.inboxes[dst_rank].qsize()})
         self.fabric.inboxes[dst_rank].put(
             (tag, self.rank, _wire_copy(payload), self._pb_outgoing()))
+        if wire:
+            pins.fire(pins.COMM_SEND_END, None,
+                      {"rank": self.rank, "peer": dst_rank, "tag": tag,
+                       "bytes": nbytes})
         peer = self.fabric.engines[dst_rank]
         if peer is not None and peer.context is not None:
             peer.context._notify_work()
@@ -152,6 +166,14 @@ class InprocComm(CommEngine):
                 if cb is None:
                     debug.warning("rank %d: AM on unregistered tag %d", self.rank, tag)
                     continue
+                # recv span: covers the AM dispatch (deserialize-free on
+                # this fabric, so the span is the handler's own work)
+                wire = pins.active(pins.COMM_RECV_BEGIN)
+                if wire:
+                    pins.fire(pins.COMM_RECV_BEGIN, None,
+                              {"rank": self.rank, "peer": src, "tag": tag,
+                               "bytes": _payload_bytes(payload),
+                               "qdepth": inbox.qsize()})
                 try:
                     cb(src, payload)
                 except Exception as e:
@@ -159,6 +181,11 @@ class InprocComm(CommEngine):
                     import traceback
 
                     traceback.print_exc()
+                finally:
+                    if wire:
+                        pins.fire(pins.COMM_RECV_END, None,
+                                  {"rank": self.rank, "peer": src,
+                                   "tag": tag})
                 n += 1
                 self.stats[f"am_recv_{tag}"] += 1
         finally:

@@ -222,7 +222,8 @@ class RemoteDepManager:
                     self.stats["get_advertised"] += 1
                     if pins.active(pins.COMM_DATA_CTL):
                         pins.fire(pins.COMM_DATA_CTL, None,
-                                  {"dst": child, "bytes": payload.nbytes})
+                                  {"rank": self.ce.rank, "dst": child,
+                                   "bytes": payload.nbytes})
             msg = {
                 "pool": pool,
                 "kind": "agg",
@@ -239,8 +240,8 @@ class RemoteDepManager:
             self.stats["activations_sent"] += 1
             if pins.active(pins.COMM_ACTIVATE):
                 pins.fire(pins.COMM_ACTIVATE, None,
-                          {"dst": child, "bytes": _wire_len(msg),
-                           "class": src_class})
+                          {"rank": self.ce.rank, "dst": child,
+                           "bytes": _wire_len(msg), "class": src_class})
             self.ce.send_am(TAG_ACTIVATE, child, msg)
 
     def send_writeback(self, tp, collection_name: str, key: Tuple,
@@ -339,7 +340,8 @@ class RemoteDepManager:
                 resolved[fi] = d["data"]
                 if pins.active(pins.COMM_DATA_PLD):
                     pins.fire(pins.COMM_DATA_PLD, None,
-                              {"bytes": d["data"].nbytes, "kind": "inline"})
+                              {"rank": self.ce.rank, "peer": src_rank,
+                               "bytes": d["data"].nbytes, "kind": "inline"})
         if not gets:
             self._complete_incoming(tp, msg, resolved, msg.get("lost", 0))
             return
@@ -362,7 +364,8 @@ class RemoteDepManager:
                 resolved[fi] = buf
                 if pins.active(pins.COMM_DATA_PLD):
                     pins.fire(pins.COMM_DATA_PLD, None,
-                              {"bytes": buf.nbytes, "kind": "get"})
+                              {"rank": self.ce.rank, "peer": src_rank,
+                               "bytes": buf.nbytes, "kind": "get"})
             remaining[0] -= 1
             if remaining[0] == 0:
                 self._complete_incoming(tp, msg, resolved, failed[0])
@@ -434,13 +437,15 @@ class RemoteDepManager:
             self.stats["dtd_get_advertised"] += 1
             if pins.active(pins.COMM_DATA_CTL):
                 pins.fire(pins.COMM_DATA_CTL, None,
-                          {"dst": dst_rank, "bytes": payload.nbytes})
+                          {"rank": self.ce.rank, "dst": dst_rank,
+                           "bytes": payload.nbytes})
         self.stats["dtd_sent"] += 1
         if pins.active(pins.COMM_ACTIVATE):
             # DTD tile shipments are activations too (shadow-task wire):
             # header = pool + tile key + epoch words
             pins.fire(pins.COMM_ACTIVATE, None,
-                      {"dst": dst_rank, "bytes": 4 * (2 + _key_words(wire_key)),
+                      {"rank": self.ce.rank, "dst": dst_rank,
+                       "bytes": 4 * (2 + _key_words(wire_key)),
                        "class": "dtd"})
         self.ce.send_am(TAG_DTD, dst_rank, msg)
 
@@ -463,7 +468,8 @@ class RemoteDepManager:
                 return
             if pins.active(pins.COMM_DATA_PLD):
                 pins.fire(pins.COMM_DATA_PLD, None,
-                          {"bytes": buf.nbytes, "kind": msg["kind"]})
+                          {"rank": self.ce.rank, "peer": src_rank,
+                           "bytes": buf.nbytes, "kind": msg["kind"]})
             tp.dtd_incoming(key, msg["epoch"], buf)
 
         if msg["kind"] == "get":

@@ -58,6 +58,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..profiling import pins
 from ..utils import debug, mca_param, register_component
 from .engine import CommEngine, MAX_AM_TAGS
 
@@ -562,12 +563,20 @@ class TCPComm(CommEngine):
             buffer_callback=lambda pb: bufs.append(pb.raw()) and None)
         head = (_HDR.pack(_MAGIC, _WIRE_VERSION, len(blob), len(bufs))
                 + b"".join(_BUFLEN.pack(b.nbytes) for b in bufs) + blob)
-        self.stats["am_bytes"] += len(head) + sum(b.nbytes for b in bufs)
+        frame_bytes = len(head) + sum(b.nbytes for b in bufs)
+        self.stats["am_bytes"] += frame_bytes
         self.stats["frames_sent"] += 1
         sock = self._socks.get(dst)
         if sock is None:
             debug.error("rank %d: no route to rank %d", self.rank, dst)
             return
+        # transport span on the comm thread's stream: one frame on the
+        # wire, with bytes, peer, and the command-queue depth behind it
+        wire = pins.active(pins.COMM_SEND_BEGIN)
+        if wire:
+            pins.fire(pins.COMM_SEND_BEGIN, None,
+                      {"rank": self.rank, "peer": dst,
+                       "bytes": frame_bytes, "qdepth": self._cmds.qsize()})
         try:
             # byte-tracked sends: sendall on a non-blocking socket can
             # transmit part of the frame before raising, with no way to
@@ -576,7 +585,14 @@ class TCPComm(CommEngine):
             self._send_tracked(sock, head)
             for b in bufs:
                 self._send_tracked(sock, b)
+            if wire:
+                pins.fire(pins.COMM_SEND_END, None,
+                          {"rank": self.rank, "peer": dst,
+                           "bytes": frame_bytes})
         except OSError as e:
+            if wire:
+                pins.fire(pins.COMM_SEND_END, None,
+                          {"rank": self.rank, "peer": dst, "bytes": 0})
             if not self._closing.is_set():
                 debug.error("rank %d: send to %d failed: %s", self.rank, dst, e)
             else:
@@ -768,10 +784,22 @@ class TCPComm(CommEngine):
             del views, holders  # only consumer chains keep slots alive now
         self._pb_incoming(src, pb)  # state first: it describes the sender
         # as of (at latest) this frame's messages
+        # recv span: one frame's dispatch (unpickle already done above;
+        # the span is the AM handlers' own work — release_deps etc.)
+        wire = pins.active(pins.COMM_RECV_BEGIN)
+        if wire:
+            pins.fire(pins.COMM_RECV_BEGIN, None,
+                      {"rank": self.rank, "peer": src,
+                       "bytes": len(st.ctl) + sum(st.lens)})
         n = 0
-        for tag, payload in batch:
-            self._dispatch(tag, src, payload)
-            n += 1
+        try:
+            for tag, payload in batch:
+                self._dispatch(tag, src, payload)
+                n += 1
+        finally:
+            if wire:
+                pins.fire(pins.COMM_RECV_END, None,
+                          {"rank": self.rank, "peer": src})
         return n
 
     def _rx_abort(self, st: _RecvState) -> None:

@@ -361,8 +361,16 @@ class Context:
         they would only have consumed the failed task's stale data.  The
         old contain-and-continue policy let a raising producer forward
         its UNMODIFIED input downstream and report success (found by the
-        dtt_pingpong port, round 5).  Local fail only, for the same
-        parked-abort reason the device layer documents."""
+        dtt_pingpong port, round 5).
+
+        With nranks > 1 the failure is broadcast through
+        ``remote_dep._fail_pool_everywhere`` so healthy peer ranks abort
+        fast instead of blocking until their full wait() timeout
+        (ADVICE.md round-5 item 3) — the abort path discriminates
+        parked / completed / live pools per rank, so a peer that never
+        instantiated the pool parks the abort and a peer that already
+        finished drops it.  Single-rank (or comm-less) contexts keep the
+        local fail."""
         es.stats["executed"] += 1
         try:
             scheduling.task_progress(self, es, task)
@@ -375,8 +383,13 @@ class Context:
             traceback.print_exc()
             from ..comm.remote_dep import _fail_pool
 
-            _fail_pool(task.taskpool,
-                       f"task {task!r} body raised: {type(e).__name__}: {e}")
+            why = f"task {task!r} body raised: {type(e).__name__}: {e}"
+            rd = getattr(self.comm, "remote_dep", None) \
+                if self.comm is not None else None
+            if self.nranks > 1 and rd is not None:
+                rd._fail_pool_everywhere(task.taskpool, why)
+            else:
+                _fail_pool(task.taskpool, why)
             # do NOT run the completion side: release_deps would forward
             # the failed task's stale payloads to REMOTE successors (and
             # write stale data back to remote home tiles) — healthy peer

@@ -399,26 +399,40 @@ class TpuDevice(Device):
         power-of-2) jitted multi-body programs: ONE device enqueue per
         chunk instead of one per task (round-4 VERDICT #6).
 
-        Failure containment: staging/trace/enqueue errors RAISE before
-        any task has side effects — the manager's per-task fallback is
-        safe (functional bodies, no donation).  Once a task's epilog
-        begins, errors are contained HERE with a loud pool fail (the
-        same discipline as ``_submit_one``'s completed branch): a
-        half-committed task must be neither retried (double-apply) nor
-        silently skipped (wait() would hang to timeout)."""
+        Inputs are staged PER CHUNK, immediately before that chunk's
+        dispatch: peak HBM holds one chunk's inputs plus its in-flight
+        outputs, never the whole wave's — a large wave of large tiles
+        must not OOM where per-task dispatch would not (ADVICE.md
+        round 5, items 1-2).
+
+        Failure containment is a PER-CHUNK invariant: a chunk's
+        staging/trace/enqueue errors RAISE before any task of THAT chunk
+        has side effects, so the manager's per-task fallback is safe for
+        every not-yet-committed task (functional bodies, no donation).
+        Earlier chunks of the same wave may already have committed their
+        epilogs by then — the fallback does not double-run them only
+        because each committed task is marked ``_tpu_completed``, which
+        the manager-loop fallback checks before resubmitting.  Once a
+        task's epilog begins, errors are contained HERE with a loud pool
+        fail (the same discipline as ``_submit_one``'s completed
+        branch): a half-committed task must be neither retried
+        (double-apply) nor silently skipped (wait() would hang to
+        timeout)."""
         from ..core import scheduling
 
         body = tasks[0].selected_chore.body_fn
-        staged = [self._stage_task_args(t, body) for t in tasks]
-        arity = len(staged[0][0])
-        nout = len(staged[0][1])
         base_key = getattr(body, "_jit_key", None) or id(body)
+        arity: Optional[int] = None
+        nout: Optional[int] = None
         start = 0
         remaining = len(tasks)
         while remaining:
             cnt = 1 << (remaining.bit_length() - 1)  # largest pow2 chunk
             grp = tasks[start:start + cnt]
-            gst = staged[start:start + cnt]
+            gst = [self._stage_task_args(t, body) for t in grp]
+            if arity is None:
+                arity = len(gst[0][0])
+                nout = len(gst[0][1])
             start += cnt
             remaining -= cnt
             key = ("wave", base_key, arity, nout, cnt)

@@ -84,6 +84,23 @@ _SAFE_BUILTINS = {
 #: is measurable there
 _EVAL_GLOBALS = {"__builtins__": _SAFE_BUILTINS}
 
+#: cumulative existence/validity predicate WORK units: one per direct
+#: ``instance_exists`` evaluation (memo misses + unmemoized calls), one
+#: per O(1) range-membership check inside ``valid``, and one per
+#: MATERIALIZED candidate value when a parameter's range has to be
+#: expanded — so an implementation that enumerates a producer's
+#: parameter span scales this counter with the span.  Monotone,
+#: process-wide, incremented under the GIL; read via
+#: :func:`exists_eval_count` and difference around a run — the
+#: deterministic replacement for the wall-clock scaling assertion of
+#: tests/dsl/test_exists_stress.py (ADVICE.md round-5 item 5).
+_exists_evals = 0
+
+
+def exists_eval_count() -> int:
+    """Current value of the existence-predicate work counter."""
+    return _exists_evals
+
 
 def _c_to_py(src: str) -> str:
     """Accept the C boolean operators of reference JDF expressions
@@ -466,13 +483,24 @@ class PTGTaskClass:
         yield from rec(0, dict(constants), ())
 
     def valid(self, locals_: Tuple, constants: Dict[str, Any]) -> bool:
+        global _exists_evals
         env = dict(constants)
         it = iter(locals_)
         for name, expr, is_param in self.decls:
             if is_param:
                 v = next(it)
                 vals = expr.values(env)
-                if v not in (vals if isinstance(vals, range) else tuple(vals)):
+                if isinstance(vals, range):
+                    # O(1) range membership — one work unit
+                    _exists_evals += 1
+                else:
+                    # materialized candidates: count them, so a predicate
+                    # that ENUMERATES a parameter span shows up in the
+                    # counter as O(span) work (test_exists_stress pins
+                    # the O(#params) law on this, not on wall-clock)
+                    vals = tuple(vals)
+                    _exists_evals += max(len(vals), 1)
+                if v not in vals:
                     return False
                 env[name] = v
             else:
@@ -553,14 +581,22 @@ class PTGTaskClass:
         because existence depends only on the taskpool constants, never
         on dynamic guard state) bounds even that to one evaluation per
         distinct (class, key) under guard-heavy webs that re-derive the
-        same reference per input."""
+        same reference per input.
+
+        Every DIRECT evaluation (memo miss included) bumps the module
+        counter read by :func:`exists_eval_count` — tests pin the O(1)
+        law on that counter instead of wall-clock (ADVICE.md round-5
+        item 5: timing-ratio assertions flake on loaded hosts)."""
+        global _exists_evals
         if memo is not None:
             mk = (self.name, key)
             r = memo.get(mk)
             if r is None:
+                _exists_evals += 1
                 r = memo[mk] = (len(key) == len(self.param_names)
                                 and self.valid(key, constants))
             return r
+        _exists_evals += 1
         return len(key) == len(self.param_names) and self.valid(key, constants)
 
     def rank_of(self, locals_: Tuple, constants: Dict[str, Any]) -> int:

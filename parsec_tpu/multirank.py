@@ -30,6 +30,7 @@ def run_multirank_perf(
     fabric=None,
     overlap: bool = False,
     flops: Optional[float] = None,
+    trace_dir: Optional[str] = None,
 ) -> Tuple[List[Any], Dict]:
     """Run one taskpool per rank to quiescence and return perf stats.
 
@@ -38,9 +39,16 @@ def run_multirank_perf(
     collection, usually).  Returns ``(users, stats)`` where ``stats``
     carries ``wall_s`` / ``executed_tasks`` / ``tasks_per_s`` /
     ``activations`` (+ ``gflops`` when ``flops`` is given, computed as
-    flops/wall — the *aggregate* figure a SYNC_TIME_PRINT row reports)
-    and, with ``overlap=True`` on a native-enabled build, the
-    ``overlap_fraction`` / ``n_comm_events`` / ``busy_us`` trio.
+    flops/wall — the *aggregate* figure a SYNC_TIME_PRINT row reports).
+
+    With ``overlap=True`` (or any ``trace_dir``) on a native-enabled
+    build, every rank records its OWN binary trace stream — with a
+    clock-alignment handshake at pool start — and ``stats`` carries the
+    PER-RANK comm/compute overlap (``overlap_fraction`` = mean across
+    ranks, ``overlap_min``, ``overlap_per_rank``, plus the legacy
+    unioned ``overlap_union``).  With ``trace_dir`` the per-rank
+    ``rank<r>.pbt`` dumps and ONE merged Chrome trace (one track per
+    rank; ``stats["merged_trace"]``) are written there.
 
     Raises on any rank error or failed quiescence — after every context
     is finalized, so a failure cannot leak worker threads.  The returned
@@ -50,10 +58,13 @@ def run_multirank_perf(
     from .comm import InprocFabric
 
     stats: Dict = {}
-    if overlap and native.available():
+    traces = None
+    if (overlap or trace_dir is not None) and native.available():
+        from .profiling.binary import RankTraceSet
         from .profiling.overlap import measure_overlap
 
-        scope = measure_overlap(stats)
+        traces = RankTraceSet(nranks)
+        scope = measure_overlap(stats, trace_dir=trace_dir, traces=traces)
     else:
         scope = contextlib.nullcontext()
 
@@ -69,6 +80,13 @@ def run_multirank_perf(
 
         def worker(r):
             try:
+                if traces is not None and nranks > 1:
+                    # pool-start clock alignment: each rank's trace
+                    # records its monotonic offset to rank 0 so the
+                    # offline merge lands every rank on one timeline
+                    from .profiling.merge import clock_handshake
+
+                    traces.set_clock_offset(r, clock_handshake(ces[r]))
                 tp, users[r] = build(r, ctxs[r])
                 ctxs[r].add_taskpool(tp)
                 oks[r] = tp.wait(timeout=timeout)

@@ -82,12 +82,20 @@ def _pivoted_panel(A, k0: int, nb: int):
 
 
 def _make_lu_body(n: int, nb: int, strip: int, prec, kt: int, bf16=False,
-                  pivot: str = "block", fused_update: bool = False):
+                  pivot: str = "block", fused_update: bool = False,
+                  solve_prec=None):
     """``bf16`` mirrors the cholesky levers (ops/segmented_chol.py):
     False = f32 3-pass trailing update; True = bf16 OPERANDS into the
     trailing gemm with f32 accumulation (ONE MXU pass instead of three —
     the update is ~all the flops); ``"storage"`` = the matrix itself
     lives in bf16 (panel math upcast to f32) — HALF the HBM traffic.
+
+    ``solve_prec`` is the MXU precision of the two panel/row solve gemms
+    (default: ``prec``).  The round-5 change dropped them from HIGHEST
+    to the 3-pass HIGH for throughput (they otherwise cost ~the whole
+    trailing update); callers who relied on HIGHEST solves pass
+    ``solve_prec=Precision.HIGHEST`` to restore the old numerics
+    (ADVICE.md round-5 item 4).
 
     ``fused_update`` (f32 path only; round-4 VERDICT #5): the trailing
     update runs as the fused single-kernel Pallas 3-pass
@@ -102,7 +110,10 @@ def _make_lu_body(n: int, nb: int, strip: int, prec, kt: int, bf16=False,
     scalar chain (VPU) plus an O(n x n) row gather per panel."""
     store_bf16 = bf16 == "storage"
     if pivot == "panel":
-        return _make_lu_body_panelpiv(n, nb, strip, prec, kt, bf16)
+        return _make_lu_body_panelpiv(n, nb, strip, prec, kt, bf16,
+                                      solve_prec=solve_prec)
+    if solve_prec is None:
+        solve_prec = prec
     if fused_update and (store_bf16 or bf16):
         raise ValueError("fused_update is the f32-path lever (bf16 modes "
                          "already run one MXU pass)")
@@ -110,7 +121,6 @@ def _make_lu_body(n: int, nb: int, strip: int, prec, kt: int, bf16=False,
     def step(M, k):
         k0 = k * nb
         f32 = jnp.float32 if store_bf16 else M.dtype
-        hi = Precision.HIGHEST
         eye = jnp.eye(nb, dtype=f32)
         D = M[k0:k0 + nb, k0:k0 + nb].astype(f32)
         P_, L_D, U_D = jax.scipy.linalg.lu(D)
@@ -128,15 +138,16 @@ def _make_lu_body(n: int, nb: int, strip: int, prec, kt: int, bf16=False,
             (jnp.triu(U_D) + jnp.tril(L_D, -1)).astype(M.dtype))
         if k0 + nb >= n:
             return M
-        # panel/row solves at ``prec`` (HIGH, 3-pass), not HIGHEST: the
-        # two full-extent solve gemms cost ~as much MXU time as the whole
-        # trailing update when run 6-pass — the round-5 profile showed
-        # they, not the update, bound f32 getrf (measured err stays
-        # f32-class: products against nb x nb inverse factors)
+        # panel/row solves at ``solve_prec`` (default HIGH, 3-pass), not
+        # HIGHEST: the two full-extent solve gemms cost ~as much MXU
+        # time as the whole trailing update when run 6-pass — the
+        # round-5 profile showed they, not the update, bound f32 getrf
+        # (measured err stays f32-class: products against nb x nb
+        # inverse factors).  solve_prec=HIGHEST restores the old solves.
         Lp = jnp.matmul(M[k0 + nb:, k0:k0 + nb].astype(f32), invU,
-                        precision=prec)
+                        precision=solve_prec)
         Ur = jnp.matmul(invL, M[k0:k0 + nb, k0 + nb:].astype(f32),
-                        precision=prec)
+                        precision=solve_prec)
         M = M.at[k0 + nb:, k0:k0 + nb].set(Lp.astype(M.dtype))
         M = M.at[k0:k0 + nb, k0 + nb:].set(Ur.astype(M.dtype))
         if store_bf16 or bf16:
@@ -174,25 +185,30 @@ def _make_lu_body(n: int, nb: int, strip: int, prec, kt: int, bf16=False,
     panel._static_values = True
     panel._donate_args = (0,)
     panel._jit_key = ("seglu_panel", n, nb, strip, str(prec), kt, str(bf16),
-                      fused_update)
+                      fused_update, str(solve_prec))
     return panel
 
 
 def _make_lu_body_panelpiv(n: int, nb: int, strip: int, prec, kt: int,
-                           bf16=False):
+                           bf16=False, solve_prec=None):
     """Panel-wide partial pivoting variant (``pivot="panel"``): the
     pivoted getf2 factors each full-height panel, its row permutation is
     applied across ALL columns, and the composed permutation rides a
     second INOUT flow (the pivot vector V: ``V[i]`` = original row index
-    now at row i, so ``A[V] = L @ U``).  f32 only for now."""
+    now at row i, so ``A[V] = L @ U``).  f32 only for now.
+
+    ``solve_prec`` defaults to HIGHEST here (this path never took the
+    round-5 solve downgrade — true partial pivoting is the
+    numerics-first mode)."""
     if bf16:
         raise NotImplementedError(
             "pivot='panel' currently supports f32 storage only")
+    if solve_prec is None:
+        solve_prec = Precision.HIGHEST
 
     def step(M, V, k):
         k0 = k * nb
         f32 = M.dtype
-        hi = Precision.HIGHEST
         C, perm = _pivoted_panel(M[:, k0:k0 + nb], k0, nb)
         # the panel's swaps apply to EVERY column and compose into V
         M = M[perm]
@@ -203,7 +219,7 @@ def _make_lu_body_panelpiv(n: int, nb: int, strip: int, prec, kt: int,
         L_D = jnp.tril(C[k0:k0 + nb], -1) + jnp.eye(nb, dtype=f32)
         invL = lax.linalg.triangular_solve(
             L_D, jnp.eye(nb, dtype=f32), lower=True, left_side=True)
-        Ur = jnp.matmul(invL, M[k0:k0 + nb, k0 + nb:], precision=hi)
+        Ur = jnp.matmul(invL, M[k0:k0 + nb, k0 + nb:], precision=solve_prec)
         M = M.at[k0:k0 + nb, k0 + nb:].set(Ur)
         Lp = C[k0 + nb:, :]  # the stored multipliers ARE the L panel
         for c0 in range(k0 + nb, n, strip):
@@ -223,12 +239,14 @@ def _make_lu_body_panelpiv(n: int, nb: int, strip: int, prec, kt: int,
 
     panel._static_values = True
     panel._donate_args = (0, 1)
-    panel._jit_key = ("seglu_panel_pp", n, nb, strip, str(prec), kt)
+    panel._jit_key = ("seglu_panel_pp", n, nb, strip, str(prec), kt,
+                      str(solve_prec))
     return panel
 
 
 def _make_lu_body_generic(n: int, nb: int, strip: int, prec, kt: int,
-                          bf16=False, fused_update: bool = False):
+                          bf16=False, fused_update: bool = False,
+                          solve_prec=None):
     """Parameter-generic getrf panel body: ONE compiled program for every
     k (traced scalar + ``lax.dynamic_slice``; round-3 VERDICT #3).
 
@@ -248,6 +266,8 @@ def _make_lu_body_generic(n: int, nb: int, strip: int, prec, kt: int,
     at 5x faster compile, hence the default."""
     nt = n // nb
     store_bf16 = bf16 == "storage"
+    if solve_prec is None:
+        solve_prec = prec
     if fused_update and (store_bf16 or bf16):
         raise ValueError("fused_update is the f32-path lever (bf16 modes "
                          "already run one MXU pass)")
@@ -255,7 +275,6 @@ def _make_lu_body_generic(n: int, nb: int, strip: int, prec, kt: int,
     def step(k, M):
         k0 = k * nb
         f32 = jnp.float32 if store_bf16 else M.dtype
-        hi = Precision.HIGHEST
         eye = jnp.eye(nb, dtype=f32)
         D = lax.dynamic_slice(M, (k0, k0), (nb, nb)).astype(f32)
         P_, L_D, U_D = jax.scipy.linalg.lu(D)
@@ -273,12 +292,13 @@ def _make_lu_body_generic(n: int, nb: int, strip: int, prec, kt: int,
             M, (jnp.triu(U_D) + jnp.tril(L_D, -1)).astype(M.dtype),
             (k0, k0))
         # full-extent solves; only the [k0+nb, n) part is ever stored.
-        # ``prec`` (3-pass), not HIGHEST: see the static body's note —
-        # these two gemms otherwise cost ~the whole trailing update
+        # ``solve_prec`` (default 3-pass), not HIGHEST: see the static
+        # body's note — these two gemms otherwise cost ~the whole
+        # trailing update; solve_prec=HIGHEST restores the old numerics
         C = lax.dynamic_slice(M, (0, k0), (n, nb)).astype(f32)
-        Lp = jnp.matmul(C, invU, precision=prec)      # rows >= k0+nb valid
+        Lp = jnp.matmul(C, invU, precision=solve_prec)  # rows >= k0+nb valid
         Rw = lax.dynamic_slice(M, (k0, 0), (nb, n)).astype(f32)
-        Ur = jnp.matmul(invL, Rw, precision=prec)     # cols >= k0+nb valid
+        Ur = jnp.matmul(invL, Rw, precision=solve_prec)  # cols >= k0+nb valid
         if store_bf16 or bf16:
             Lb, Ub = Lp.astype(jnp.bfloat16), Ur.astype(jnp.bfloat16)
 
@@ -332,7 +352,7 @@ def _make_lu_body_generic(n: int, nb: int, strip: int, prec, kt: int,
 
     panel._donate_args = (0,)
     panel._jit_key = ("seglu_panel_g", n, nb, strip, str(prec), kt,
-                      str(bf16), fused_update)
+                      str(bf16), fused_update, str(solve_prec))
     return panel
 
 
@@ -340,7 +360,8 @@ def segmented_lu_ptg(n: int, nb: int, *, strip: int = 4096,
                      prec=None, tail: int = 4096,
                      specialize: str = "generic", bf16=False,
                      pivot: str = "block",
-                     fused_update: bool = False) -> PTG:
+                     fused_update: bool = False,
+                     solve_prec=None) -> PTG:
     """Build the segmented getrf PTG (factors in place: unit-lower L
     below the diagonal, U on/above).  Instantiate with
     ``.taskpool(NT=n_segments(n, nb, tail), A=collection)``.
@@ -359,7 +380,12 @@ def segmented_lu_ptg(n: int, nb: int, *, strip: int = 4096,
     diagonally-dominant inputs nopiv targets.  ``"panel"`` = true
     partial pivoting over the full trailing column height (static
     specialization, f32 only); adds a pivot-vector flow (``PV``
-    collection) so ``A[V] = L @ U``."""
+    collection) so ``A[V] = L @ U``.
+
+    ``solve_prec``: MXU precision of the panel/row solve gemms; defaults
+    to ``prec`` (``pivot="panel"`` defaults to HIGHEST — that path never
+    took the round-5 solve downgrade).  Pass ``Precision.HIGHEST`` to
+    restore the pre-round-5 6-pass solves (at ~2x the f32 panel cost)."""
     if n % nb:
         raise ValueError(f"N={n} not divisible by nb={nb}")
     strip = min(strip, n)
@@ -382,14 +408,15 @@ def segmented_lu_ptg(n: int, nb: int, *, strip: int = 4096,
                    "<- (k == 0) ? PV(0) : V panel(k-1)",
                    "-> (k == NT-1) ? PV(0) : V panel(k+1)")
         panel.body(tpu=_make_lu_body_panelpiv(n, nb, strip, prec, kt,
-                                              bf16=bf16))
+                                              bf16=bf16,
+                                              solve_prec=solve_prec))
         return ptg
     if pivot != "block":
         raise ValueError(f"unknown pivot mode {pivot!r}")
     make = (_make_lu_body_generic if specialize == "generic"
             else _make_lu_body)
     panel.body(tpu=make(n, nb, strip, prec, kt, bf16=bf16,
-                        fused_update=fused_update))
+                        fused_update=fused_update, solve_prec=solve_prec))
     return ptg
 
 
@@ -400,7 +427,7 @@ class SegmentedLU:
     def __init__(self, context, n: int, nb: int, *, strip: int = 4096,
                  prec=None, tail: int = 4096, specialize: str = "generic",
                  bf16=False, pivot: str = "block",
-                 fused_update: bool = False):
+                 fused_update: bool = False, solve_prec=None):
         self.context = context
         self.n, self.nb = n, nb
         self.store_bf16 = bf16 == "storage"
@@ -409,7 +436,8 @@ class SegmentedLU:
         self.ptg = segmented_lu_ptg(n, nb, strip=strip, prec=prec,
                                     tail=tail, specialize=specialize,
                                     bf16=bf16, pivot=pivot,
-                                    fused_update=fused_update)
+                                    fused_update=fused_update,
+                                    solve_prec=solve_prec)
         self.device = next(
             (d for d in context.devices if d.mca_name == "tpu"), None)
         if self.device is None:

@@ -1,0 +1,30 @@
+"""jax version-compatibility shims for the parallel layer.
+
+The SPMD surface tracks jax APIs that moved or were renamed across
+releases; every consumer imports from here so the next rename is a
+one-file fix (the axis-size shim lives in :func:`collectives.axis_size`
+for the same reason).
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.6 exports shard_map at the top level
+    from jax import shard_map
+except ImportError:  # older jax keeps it experimental
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["shard_map", "no_vma_check_kwargs"]
+
+
+def no_vma_check_kwargs() -> dict:
+    """kwargs that disable shard_map's varying-manual-axes consistency
+    check under whichever name this jax spells it (``check_vma``,
+    previously ``check_rep``; absent on builds without the check)."""
+    params = inspect.signature(shard_map).parameters
+    if "check_vma" in params:
+        return {"check_vma": False}
+    if "check_rep" in params:
+        return {"check_rep": False}
+    return {}

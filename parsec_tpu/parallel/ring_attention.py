@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ._compat import no_vma_check_kwargs, shard_map
 
 _NEG_BIG = -1e30  # finite "-inf" for running-max init (keeps exp() NaN-free)
 
@@ -137,12 +137,10 @@ def ring_attention(
         return jnp.transpose(out, (0, 2, 1, 3)).astype(q_blk.dtype)  # -> [B,Sb,H,D]
 
     spec = P(None, axis, None, None)
-    kw = {}
-    if use_pallas:
-        # pallas_call's out_shape carries no varying-manual-axes info, so
-        # the vma consistency check cannot see through it — disable it for
-        # this path (numerics are covered by the oracle tests)
-        kw["check_vma"] = False
+    # pallas_call's out_shape carries no varying-manual-axes info, so the
+    # vma consistency check cannot see through it — disable it for this
+    # path (numerics are covered by the oracle tests)
+    kw = no_vma_check_kwargs() if use_pallas else {}
     f = shard_map(kernel, mesh=mesh, in_specs=(spec, spec, spec),
                   out_specs=spec, **kw)
     return jax.jit(f)(q, k, v)
@@ -182,7 +180,10 @@ def ulysses_attention(
 
 def _varying(x, axis):
     """Mark a constant as device-varying inside shard_map (pvary was
-    deprecated in favour of pcast)."""
+    deprecated in favour of pcast; jax builds predating both don't
+    require the annotation at all)."""
     if hasattr(lax, "pcast"):
         return lax.pcast(x, axis, to="varying")
-    return lax.pvary(x, (axis,))
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, (axis,))
+    return x

@@ -11,13 +11,13 @@ from .sde import SDEModule
 
 __all__ = ["pins", "Trace", "TaskProfiler", "CommProfiler", "DotGrapher",
            "dictionary", "sde", "SDEModule", "AlperfModule",
-           "BinaryTrace", "BinaryTaskProfiler"]
+           "BinaryTrace", "BinaryTaskProfiler", "RankTraceSet"]
 
 
 def __getattr__(name):
     # binary tracer needs the native toolchain: import lazily so the
     # package loads even where g++ is unavailable
-    if name in ("BinaryTrace", "BinaryTaskProfiler"):
+    if name in ("BinaryTrace", "BinaryTaskProfiler", "RankTraceSet"):
         from . import binary
 
         return getattr(binary, name)

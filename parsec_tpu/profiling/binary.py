@@ -26,6 +26,7 @@ from __future__ import annotations
 import itertools
 import json
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -53,6 +54,16 @@ class BinaryTrace:
                 f"native core unavailable: {native.build_error()}")
         self.rank = rank
         self._tracer = native.NativeTracer()
+        #: absolute monotonic time of the tracer's t0 (its event
+        #: timestamps are offsets from construction): captured here, on
+        #: the same CLOCK_MONOTONIC the native steady_clock reads, so
+        #: per-rank traces can be placed on one global timeline by
+        #: ``profiling.merge``
+        self.epoch_ns = time.monotonic_ns()
+        #: this rank's clock offset to rank 0 (local - rank0, ns), from
+        #: the pool-start handshake (``merge.clock_handshake``); 0 for
+        #: same-process ranks sharing the monotonic clock
+        self.clock_offset_ns = 0
         self._keywords: Dict[str, int] = {}
         self._lock = threading.Lock()
 
@@ -90,7 +101,9 @@ class BinaryTrace:
                 names[kid] = name
         with open(path + ".meta.json", "w") as f:
             json.dump({"rank": self.rank, "keywords": names,
-                       "streams": self._tracer.stream_names()}, f)
+                       "streams": self._tracer.stream_names(),
+                       "epoch_ns": self.epoch_ns,
+                       "clock_offset_ns": self.clock_offset_ns}, f)
         return n
 
     def close(self) -> None:
@@ -139,9 +152,222 @@ class BinaryTaskProfiler:
         self._subs.clear()
 
 
+class RankTraceSet:
+    """Per-rank binary trace streams over one process (the virtual-mesh
+    harness shape: N ranks as N Contexts in-process).  One
+    :class:`BinaryTrace` per rank; every PINS event routes to the firing
+    rank's OWN trace — task lifecycle by the worker's context rank,
+    comm-protocol and transport events by the ``rank`` field the comm
+    layer stamps on payloads.  This is what makes per-rank overlap and
+    the critical-path analyzer possible: rank r's comm events land next
+    to rank r's compute spans, never unioned across the mesh.
+
+    Beyond the task lifecycle the set records, per rank:
+
+    * ``class:<name>`` instants mapping each task token to its task
+      class (offline tools attribute time per class);
+    * ``dep_edge`` instants (``event_id`` = producer token, ``info`` =
+      released successor token) from the RELEASE_DEPS_END payload — the
+      dependency edges ``profiling.critpath`` walks;
+    * ``select`` spans (scheduler select latency, per worker stream) and
+      a ``steals`` counter sampled on change — the scheduler-side PINS
+      subscribers (reference ``mca/pins/print_steals`` made trace-borne);
+    * ``ce_send`` / ``ce_recv`` transport spans (bytes in ``info``, peer
+      in ``event_id``) and a ``qdepth`` counter from the comm engines;
+    * ``comm_send`` / ``comm_recv`` protocol instants (activation sent /
+      payload landed — the events the overlap metric counts).
+
+    In a TCP (multi-process) launch each process is one rank: build the
+    set with ``nranks=1`` and ``base_rank=<this rank>``; merge the
+    per-process dumps offline."""
+
+    def __init__(self, nranks: int = 1, base_rank: int = 0):
+        self.nranks = nranks
+        self.base_rank = base_rank
+        self.traces = [BinaryTrace(rank=base_rank + r)
+                       for r in range(nranks)]
+        self._seq = itertools.count(1)  # tokens unique across the set
+        self._k = [
+            {name: t.keyword(name) for name in
+             ("exec", "prepare_input", "complete_exec", "select",
+              "dep_edge", "comm_send", "comm_recv", "comm_ctl",
+              "ce_send", "ce_recv", "qdepth", "steals")}
+            for t in self.traces]
+        self._steals_seen: Dict[int, int] = {}
+        self._subs: List[Any] = []
+        self._installed = False
+
+    # -- routing ---------------------------------------------------------
+    def _trace_of(self, rank: int) -> Optional[BinaryTrace]:
+        i = rank - self.base_rank
+        return self.traces[i] if 0 <= i < self.nranks else None
+
+    @staticmethod
+    def _es_rank(es, task=None) -> int:
+        if es is not None:
+            return es.context.rank
+        ctx = getattr(getattr(task, "taskpool", None), "context", None)
+        return getattr(ctx, "rank", 0)
+
+    def _tok(self, task) -> int:
+        prof = task.prof
+        t = prof.get("pbt_token")
+        if t is None:
+            t = prof["pbt_token"] = next(self._seq)
+            r = self._es_rank(None, task)
+            tr = self._trace_of(r)
+            if tr is not None:
+                name = getattr(task.task_class, "name",
+                               type(task).__name__)
+                tr.instant(tr.keyword(f"class:{name}"), t)
+        return t
+
+    # -- lifecycle -------------------------------------------------------
+    def install(self) -> "RankTraceSet":
+        if self._installed:
+            return self
+        self._installed = True
+
+        def sub(site, cb):
+            pins.subscribe(site, cb)
+            self._subs.append((site, cb))
+
+        def task_cb(key, phase):
+            def cb(es, task):
+                r = self._es_rank(es, task)
+                tr = self._trace_of(r)
+                if tr is not None:
+                    getattr(tr, phase)(self._k[r - self.base_rank][key],
+                                       self._tok(task))
+            return cb
+
+        sub(pins.EXEC_BEGIN, task_cb("exec", "begin"))
+        sub(pins.EXEC_END, task_cb("exec", "end"))
+        sub(pins.PREPARE_INPUT_BEGIN, task_cb("prepare_input", "begin"))
+        sub(pins.PREPARE_INPUT_END, task_cb("prepare_input", "end"))
+        sub(pins.COMPLETE_EXEC_BEGIN, task_cb("complete_exec", "begin"))
+        sub(pins.COMPLETE_EXEC_END, task_cb("complete_exec", "end"))
+
+        def on_release(es, payload):
+            task, ready = payload
+            r = self._es_rank(es, task)
+            tr = self._trace_of(r)
+            if tr is None:
+                return
+            kid = self._k[r - self.base_rank]["dep_edge"]
+            src = self._tok(task)
+            for succ in ready or ():
+                tr.instant(kid, src, self._tok(succ))
+
+        sub(pins.RELEASE_DEPS_END, on_release)
+
+        # scheduler-side subscribers: select latency spans + steal counts
+        def on_select_begin(es, _):
+            r = self._es_rank(es)
+            tr = self._trace_of(r)
+            if tr is not None:
+                tr.begin(self._k[r - self.base_rank]["select"])
+
+        def on_select_end(es, task):
+            r = self._es_rank(es)
+            tr = self._trace_of(r)
+            if tr is None:
+                return
+            ks = self._k[r - self.base_rank]
+            tr.end(ks["select"], 1 if task is not None else 0)
+            if es is not None:
+                steals = es.stats.get("steals", 0)
+                key = id(es)
+                if steals != self._steals_seen.get(key):
+                    self._steals_seen[key] = steals
+                    tr.counter(ks["steals"], steals)
+
+        sub(pins.SELECT_BEGIN, on_select_begin)
+        sub(pins.SELECT_END, on_select_end)
+
+        # comm-protocol instants (fired with es=None; rank rides the
+        # payload) — the events the overlap fraction counts
+        def comm_cb(key):
+            def cb(es, info):
+                info = info or {}
+                tr = self._trace_of(info.get("rank", 0))
+                if tr is not None:
+                    tr.instant(
+                        self._k[tr.rank - self.base_rank][key],
+                        info.get("dst", info.get("peer", 0)) or 0,
+                        int(info.get("bytes", 0)))
+            return cb
+
+        sub(pins.COMM_ACTIVATE, comm_cb("comm_send"))
+        sub(pins.COMM_DATA_PLD, comm_cb("comm_recv"))
+        sub(pins.COMM_DATA_CTL, comm_cb("comm_ctl"))
+
+        # transport spans from the comm engines (bytes/peer/queue depth)
+        def wire_cb(key, phase):
+            def cb(es, info):
+                info = info or {}
+                tr = self._trace_of(info.get("rank", 0))
+                if tr is None:
+                    return
+                ks = self._k[tr.rank - self.base_rank]
+                getattr(tr, phase)(ks[key], int(info.get("peer", 0)),
+                                   int(info.get("bytes", 0)))
+                if phase == "begin" and "qdepth" in info:
+                    tr.counter(ks["qdepth"], int(info["qdepth"]))
+            return cb
+
+        sub(pins.COMM_SEND_BEGIN, wire_cb("ce_send", "begin"))
+        sub(pins.COMM_SEND_END, wire_cb("ce_send", "end"))
+        sub(pins.COMM_RECV_BEGIN, wire_cb("ce_recv", "begin"))
+        sub(pins.COMM_RECV_END, wire_cb("ce_recv", "end"))
+        return self
+
+    def uninstall(self) -> None:
+        for site, cb in self._subs:
+            pins.unsubscribe(site, cb)
+        self._subs.clear()
+        self._installed = False
+
+    # -- clock alignment / dump ------------------------------------------
+    def set_clock_offset(self, rank: int, offset_ns: int) -> None:
+        tr = self._trace_of(rank)
+        if tr is not None:
+            tr.clock_offset_ns = int(offset_ns)
+
+    def dump(self, directory: str) -> List[str]:
+        """Write one ``rank<r>.pbt`` (+ sidecar) per rank; returns the
+        paths, merge-ready for :func:`profiling.merge.merge_traces`."""
+        import os
+
+        os.makedirs(directory, exist_ok=True)
+        paths = []
+        for tr in self.traces:
+            p = os.path.join(directory, f"rank{tr.rank}.pbt")
+            tr.dump(p)
+            paths.append(p)
+        return paths
+
+    def close(self) -> None:
+        for tr in self.traces:
+            tr.close()
+
+
 # ---------------------------------------------------------------------------
 # offline readers (reference dbpreader.c / pbt2ptt)
 # ---------------------------------------------------------------------------
+
+def read_pbt_meta(path: str) -> Dict[str, Any]:
+    """The sidecar dictionary of a .pbt dump (rank, keyword/stream
+    tables, clock epoch + handshake offset); empty-ish defaults when the
+    sidecar is missing."""
+    meta: Dict[str, Any] = {"keywords": [], "streams": [], "rank": 0}
+    try:
+        with open(path + ".meta.json") as f:
+            meta.update(json.load(f))
+    except OSError:
+        pass
+    return meta
+
 
 def read_pbt(path: str) -> List[Dict[str, Any]]:
     """Parse a .pbt file (+ sidecar) into event dicts."""
@@ -151,12 +377,7 @@ def read_pbt(path: str) -> List[Dict[str, Any]]:
             raise ValueError(f"{path}: not a PBTRACE1 file")
         count = int(np.frombuffer(f.read(8), "<i8")[0])
         recs = np.fromfile(f, dtype=_RECORD_DTYPE, count=count)
-    meta: Dict[str, Any] = {"keywords": [], "streams": [], "rank": 0}
-    try:
-        with open(path + ".meta.json") as f:
-            meta.update(json.load(f))
-    except OSError:
-        pass
+    meta = read_pbt_meta(path)
     kw = meta["keywords"]
     streams = meta["streams"]
     out = []

@@ -1,0 +1,199 @@
+"""Offline critical-path analysis over a (merged) task trace.
+
+Reference: PaRSEC's offline tooling reconstructs task timelines from the
+binary traces and the community pairs them with DAG critical-path
+studies (the R/python analyses around ``profile2h5``); the round-5
+review diagnosed the dynamic path's ~0.5 ms/task host-bound gap only by
+hand-rolled A/B timing.  This module turns that into a tool: walk the
+recorded dependency edges backwards from the last-finishing task, and
+attribute every microsecond on the chain to one of three buckets —
+
+* **compute** — the task's own ``exec`` span;
+* **comm**    — the part of the pre-task gap covered by transport
+  activity on the SAME rank track (``ce_recv`` / ``ce_send`` spans);
+* **host gap** — the rest: scheduler select, release bookkeeping,
+  dispatch latency — time nobody computes and nothing is on the wire.
+
+Inputs are Chrome-trace events in the conventions of
+``profiling.binary`` / ``profiling.merge``: ``exec`` spans carry a task
+token in ``args.event_id``; ``dep_edge`` instants carry producer token
+in ``args.event_id`` and successor token in ``args.info``;
+``class:<name>`` instants map tokens to task classes.  Edges are
+INTRA-RANK (``pid``): a remote release has no producer task object on
+the receiving rank, so cross-rank dependencies appear not as edges but
+as transport spans inside the gap before the released task — exactly
+the comm bucket.  On a merged multi-rank trace the chain is therefore
+walked inside the rank that finishes last; the primary target is the
+single-rank dynamic-path trace (the round-5 host-bound finding).
+
+CLI: ``python -m parsec_tpu.profiling.tools critpath trace.json``.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+#: transport span names that count as wire time in gap attribution
+COMM_SPAN_NAMES = ("ce_recv", "ce_send")
+
+
+def _merge_intervals(iv: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    iv.sort()
+    out: List[List[float]] = []
+    for a, b in iv:
+        if out and a <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], b)
+        else:
+            out.append([a, b])
+    return [(a, b) for a, b in out]
+
+
+def _overlap(lo: float, hi: float, merged: Sequence[Tuple[float, float]]) -> float:
+    if hi <= lo:
+        return 0.0
+    total = 0.0
+    for a, b in merged:
+        if b <= lo:
+            continue
+        if a >= hi:
+            break
+        total += min(b, hi) - max(a, lo)
+    return total
+
+
+def analyze(events: List[dict], *, exec_name: str = "exec",
+            comm_names: Sequence[str] = COMM_SPAN_NAMES) -> dict:
+    """Reconstruct the dependency critical path and attribute its wall
+    time.  Returns a report dict::
+
+        {"wall_us", "n_tasks", "coverage",
+         "buckets": {"compute_us", "comm_us", "host_gap_us"},
+         "per_class": {cls: {"count", "compute_us", "comm_us",
+                             "host_gap_us"}},
+         "chain": [{"token", "pid", "class", "begin_us", "end_us",
+                    "gap_us", "gap_comm_us"}]}
+
+    ``coverage`` is the attributed fraction of the chain's wall clock —
+    1.0 when every pre-task gap is non-negative (async device completion
+    can overlap a successor's release with its producer's span, which
+    clamps that gap to 0 and lowers coverage)."""
+    exec_open: Dict[Tuple[Any, Any], float] = {}
+    tasks: Dict[Tuple[Any, int], dict] = {}
+    classes: Dict[Tuple[Any, int], str] = {}
+    preds: Dict[Tuple[Any, int], List[Tuple[Any, int]]] = defaultdict(list)
+    comm_open: Dict[Tuple[Any, Any, str], float] = {}
+    comm_iv: Dict[Any, List[Tuple[float, float]]] = defaultdict(list)
+
+    for e in sorted(events, key=lambda e: e.get("ts", 0.0)):
+        name, ph = e.get("name"), e.get("ph")
+        pid = e.get("pid")
+        args = e.get("args", {}) or {}
+        if name == exec_name:
+            tok = args.get("event_id")
+            key = (pid, e.get("tid"), tok)
+            if ph == "B":
+                exec_open[key] = e["ts"]
+            elif ph == "E":
+                b = exec_open.pop(key, None)
+                if b is not None and tok is not None:
+                    tasks[(pid, tok)] = {"begin": b, "end": e["ts"]}
+        elif name == "dep_edge" and ph == "i":
+            src, dst = args.get("event_id"), args.get("info")
+            if src is not None and dst is not None:
+                preds[(pid, dst)].append((pid, src))
+        elif isinstance(name, str) and name.startswith("class:") and ph == "i":
+            classes[(pid, args.get("event_id"))] = name[6:]
+        elif name in comm_names:
+            ckey = (pid, e.get("tid"), name)
+            if ph == "B":
+                comm_open[ckey] = e["ts"]
+            elif ph == "E":
+                b = comm_open.pop(ckey, None)
+                if b is not None:
+                    comm_iv[pid].append((b, e["ts"]))
+
+    empty = {"wall_us": 0.0, "n_tasks": 0, "coverage": 0.0,
+             "buckets": {"compute_us": 0.0, "comm_us": 0.0,
+                         "host_gap_us": 0.0},
+             "per_class": {}, "chain": []}
+    if not tasks:
+        return empty
+    comm_merged = {pid: _merge_intervals(iv) for pid, iv in comm_iv.items()}
+
+    # backward walk from the last-finishing task: at each step pick the
+    # predecessor that finished last (the binding one)
+    cur = max(tasks, key=lambda k: tasks[k]["end"])
+    chain: List[Tuple[Any, int]] = [cur]
+    seen = {cur}
+    while True:
+        cands = [p for p in preds.get(cur, ()) if p in tasks and p not in seen]
+        if not cands:
+            break
+        cur = max(cands, key=lambda k: tasks[k]["end"])
+        seen.add(cur)
+        chain.append(cur)
+    chain.reverse()
+
+    buckets = {"compute_us": 0.0, "comm_us": 0.0, "host_gap_us": 0.0}
+    per_class: Dict[str, Dict[str, float]] = defaultdict(
+        lambda: {"count": 0, "compute_us": 0.0, "comm_us": 0.0,
+                 "host_gap_us": 0.0})
+    rows = []
+    prev_end: Optional[float] = None
+    for key in chain:
+        t = tasks[key]
+        pid, tok = key
+        cls = classes.get(key, "?")
+        dur = t["end"] - t["begin"]
+        gap = 0.0 if prev_end is None else max(0.0, t["begin"] - prev_end)
+        gap_comm = _overlap(t["begin"] - gap, t["begin"],
+                            comm_merged.get(pid, ()))
+        buckets["compute_us"] += dur
+        buckets["comm_us"] += gap_comm
+        buckets["host_gap_us"] += gap - gap_comm
+        pc = per_class[cls]
+        pc["count"] += 1
+        pc["compute_us"] += dur
+        pc["comm_us"] += gap_comm
+        pc["host_gap_us"] += gap - gap_comm
+        rows.append({"token": tok, "pid": pid, "class": cls,
+                     "begin_us": t["begin"], "end_us": t["end"],
+                     "gap_us": gap, "gap_comm_us": gap_comm})
+        prev_end = max(t["end"], prev_end or t["end"])
+    wall = tasks[chain[-1]]["end"] - tasks[chain[0]]["begin"]
+    attributed = sum(buckets.values())
+    return {
+        "wall_us": wall,
+        "n_tasks": len(chain),
+        "coverage": (attributed / wall) if wall > 0 else 0.0,
+        "buckets": buckets,
+        "per_class": {k: dict(v) for k, v in per_class.items()},
+        "chain": rows,
+    }
+
+
+def render(report: dict) -> str:
+    """Human-readable report (the tools CLI's default output)."""
+    wall = report["wall_us"]
+    b = report["buckets"]
+    lines = [
+        f"critical path: {report['n_tasks']} tasks, "
+        f"wall {wall / 1e3:.3f} ms, "
+        f"coverage {report['coverage']:.1%}",
+    ]
+    for k in ("compute_us", "comm_us", "host_gap_us"):
+        frac = b[k] / wall if wall > 0 else 0.0
+        lines.append(f"  {k[:-3]:<10} {b[k] / 1e3:>10.3f} ms  {frac:>6.1%}")
+    if report["per_class"]:
+        lines.append(f"  {'class':<18}{'count':>6}{'compute_ms':>12}"
+                     f"{'comm_ms':>10}{'host_ms':>10}{'host_us/task':>14}")
+        for cls in sorted(report["per_class"]):
+            pc = report["per_class"][cls]
+            per_task = pc["host_gap_us"] / max(pc["count"], 1)
+            lines.append(
+                f"  {cls:<18}{pc['count']:>6}"
+                f"{pc['compute_us'] / 1e3:>12.3f}"
+                f"{pc['comm_us'] / 1e3:>10.3f}"
+                f"{pc['host_gap_us'] / 1e3:>10.3f}{per_task:>14.1f}")
+    return "\n".join(lines)

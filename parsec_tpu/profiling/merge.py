@@ -1,0 +1,194 @@
+"""Multi-rank trace alignment and merge.
+
+Reference: PaRSEC dumps one binary ``.prof`` per rank and the offline
+tools (``dbpreader.c`` multi-file mode, ``profile2h5 --merge``) stitch
+them into one timeline; clock skew across nodes is corrected by a
+start-of-run synchronization (``parsec_profiling_start`` records a
+common epoch after an MPI barrier).  Here:
+
+* :func:`clock_handshake` — the pool-start handshake: every rank
+  estimates its monotonic-clock offset to rank 0 over the comm engine
+  (ping/pong on ``TAG_CTL``, midpoint method, best-of-N by minimum
+  RTT — the classic Cristian estimate).  In-process ranks share the
+  clock and measure ~0; TCP ranks on different hosts get a real offset.
+* :func:`merge_traces` — read per-rank ``.pbt`` dumps (or Chrome JSON),
+  place every rank's events on one global timeline
+  (``epoch_ns - clock_offset_ns + ts``), and emit ONE Chrome/Perfetto
+  trace with one process track per rank (``pid`` = rank, labeled via
+  ``process_name`` metadata events).
+
+CLI: ``python -m parsec_tpu.profiling.tools merge rank*.pbt -o all.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..utils import debug
+
+#: alignment tolerance the tests pin: same-process ranks must land
+#: within this of each other after epoch alignment (python-side epoch
+#: capture vs the native t0 costs single-digit microseconds)
+ALIGN_TOLERANCE_US = 2000.0
+
+
+def clock_handshake(ce, *, pings: int = 8, timeout: float = 10.0) -> int:
+    """Collective clock-alignment handshake at pool start: every rank
+    calls this concurrently; returns this rank's estimated monotonic
+    offset to rank 0 in ns (``local - rank0``; 0 on rank 0).
+
+    Protocol (over ``TAG_CTL`` active messages): each rank != 0 sends
+    ``pings`` pings, rank 0's handler answers each with its own clock,
+    and the sample with the smallest round-trip wins (offset error is
+    bounded by rtt/2).  Rank 0 progresses until every peer reports done.
+    A timed-out handshake degrades loudly to offset 0 — tracing must
+    never fail the run it observes."""
+    from ..comm.engine import TAG_CTL
+
+    nranks = getattr(ce, "nranks", 1)
+    rank = getattr(ce, "rank", 0)
+    if nranks <= 1:
+        return 0
+    state: Dict[str, Any] = {"pong": None, "done": 0}
+    cv = threading.Condition()
+
+    def on_ctl(src: int, msg: dict) -> None:
+        op = msg.get("op")
+        if op == "clk_ping":
+            ce.send_am(TAG_CTL, src, {
+                "op": "clk_pong", "seq": msg["seq"], "t0": msg["t0"],
+                "t_ref": time.monotonic_ns()})
+        elif op == "clk_pong":
+            with cv:
+                state["pong"] = msg
+                cv.notify_all()
+        elif op == "clk_done":
+            with cv:
+                state["done"] += 1
+                cv.notify_all()
+
+    ce.register_am(TAG_CTL, on_ctl)
+    deadline = time.monotonic() + timeout
+    if rank == 0:
+        # serve pings until every peer confirmed its estimate
+        while True:
+            ce.progress_nonblocking()
+            with cv:
+                if state["done"] >= nranks - 1:
+                    return 0
+                cv.wait(0.001)
+            if time.monotonic() > deadline:
+                debug.warning(
+                    "clock handshake: rank 0 timed out with %d/%d peers "
+                    "done; offsets default to 0",
+                    state["done"], nranks - 1)
+                return 0
+    best: Optional[Tuple[int, int]] = None  # (rtt_ns, offset_ns)
+    for i in range(pings):
+        with cv:
+            state["pong"] = None
+        ce.send_am(TAG_CTL, 0,
+                   {"op": "clk_ping", "seq": i, "t0": time.monotonic_ns()})
+        # a ping racing ahead of rank 0's handler registration can be
+        # dropped (inproc warns on unregistered tags): resend until the
+        # pong lands; rtt/offset use the ECHOED t0, so a pong matching a
+        # superseded ping just measures a large rtt and loses best-of-N
+        resend_at = time.monotonic() + 0.05
+        pong = None
+        while pong is None:
+            ce.progress_nonblocking()
+            with cv:
+                p = state["pong"]
+                if p is not None and p["seq"] == i:
+                    pong = p
+                else:
+                    cv.wait(0.0005)
+            now = time.monotonic()
+            if pong is None and now > resend_at:
+                ce.send_am(TAG_CTL, 0, {"op": "clk_ping", "seq": i,
+                                        "t0": time.monotonic_ns()})
+                resend_at = now + 0.05
+            if now > deadline:
+                debug.warning("clock handshake: rank %d timed out at "
+                              "ping %d; offset defaults to 0", rank, i)
+                ce.send_am(TAG_CTL, 0, {"op": "clk_done", "rank": rank})
+                return best[1] if best is not None else 0
+        t1 = time.monotonic_ns()
+        t0 = pong["t0"]
+        rtt = t1 - t0
+        off = (t0 + t1) // 2 - pong["t_ref"]
+        if best is None or rtt < best[0]:
+            best = (rtt, off)
+    ce.send_am(TAG_CTL, 0, {"op": "clk_done", "rank": rank})
+    return best[1] if best is not None else 0
+
+
+# ---------------------------------------------------------------------------
+# offline merge
+# ---------------------------------------------------------------------------
+
+def _load_one(path: str) -> Tuple[List[dict], Dict[str, Any]]:
+    """(events, meta) for one per-rank trace: ``.pbt`` binary (events in
+    µs relative to the tracer epoch, sidecar carries epoch/offset) or a
+    Chrome JSON dump (aligned only if its metadata carries epoch_ns)."""
+    with open(path, "rb") as f:
+        head = f.read(8)
+    if head == b"PBTRACE1":
+        from .binary import read_pbt_meta, to_chrome_events
+
+        return to_chrome_events(path), read_pbt_meta(path)
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):
+        return doc, {}
+    return doc.get("traceEvents", []), doc.get("metadata", {})
+
+
+def merge_traces(paths: Sequence[str], out: Optional[str] = None) -> dict:
+    """Merge per-rank traces into one Chrome/Perfetto document with one
+    process track per rank.
+
+    Per-trace events are shifted onto the global timeline by
+    ``epoch_ns - clock_offset_ns`` (rank 0's clock is the reference; the
+    earliest aligned trace becomes t=0).  Traces without an epoch (hand-
+    written JSON) pass through unshifted.  Returns the document; with
+    ``out`` it is also written to disk."""
+    loaded = [_load_one(p) for p in paths]
+    bases: List[Optional[int]] = []
+    for _evs, meta in loaded:
+        epoch = meta.get("epoch_ns")
+        bases.append(None if epoch is None
+                     else int(epoch) - int(meta.get("clock_offset_ns", 0)))
+    known = [b for b in bases if b is not None]
+    t0 = min(known) if known else 0
+
+    ranks: List[int] = []
+    merged: List[dict] = []
+    for (evs, meta), base in zip(loaded, bases):
+        shift_us = 0.0 if base is None else (base - t0) / 1e3
+        rank = int(meta.get("rank", evs[0].get("pid", 0) if evs else 0))
+        ranks.append(rank)
+        for e in evs:
+            e = dict(e)
+            e["ts"] = float(e.get("ts", 0.0)) + shift_us
+            e.setdefault("pid", rank)
+            merged.append(e)
+    merged.sort(key=lambda e: e["ts"])
+    labels = [{"name": "process_name", "ph": "M", "pid": r, "ts": 0.0,
+               "args": {"name": f"rank {r}"}} for r in sorted(set(ranks))]
+    doc = {
+        "traceEvents": labels + merged,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "ranks": sorted(set(ranks)),
+            "aligned": len(known) == len(loaded),
+            "sources": [str(p) for p in paths],
+        },
+    }
+    if out is not None:
+        with open(out, "w") as f:
+            json.dump(doc, f)
+    return doc

@@ -5,6 +5,7 @@ VERDICT round-1 bar: dispatch-bound throughput >= 100k tasks/s at 8
 workers; measured native no-op dispatch runs in the millions/s.
 """
 
+import os
 import time
 
 import pytest
@@ -95,10 +96,18 @@ def test_gd_never_steals():
     assert g.steals == 0
 
 
+@pytest.mark.skipif(
+    (os.cpu_count() or 1) < 8,
+    reason="8-worker throughput floor needs >= 8 cores (measured 73k/s "
+           "on a 2-core box vs 1M+/s on the calibration host)")
+@pytest.mark.skipif(
+    os.environ.get("PARSEC_TPU_PERF_ASSERTS", "1") == "0",
+    reason="perf-sensitive floor disabled (PARSEC_TPU_PERF_ASSERTS=0)")
 def test_dispatch_throughput_floor():
     """>= 100k tasks/s at 8 workers, native no-op bodies (the VERDICT
     bar; measured ~1M+/s — the floor is deliberately loose for CI
-    machines under load)."""
+    machines under load, and skipped outright on hosts without the
+    cores to run 8 workers in parallel — ADVICE.md round-5 item 5)."""
     g, n = _wide_graph(10, 2000)
     t0 = time.perf_counter()
     assert g.run_noop(8) == n

@@ -3,6 +3,7 @@ reference's stencil overlap study at test scale (BASELINE.json tracks
 overlap % for the 64-chip stencil config; the metric pipeline is what
 this pins: trace -> merged exec spans -> comm instants -> fraction)."""
 
+import os
 import threading
 
 import numpy as np
@@ -17,6 +18,13 @@ from parsec_tpu.profiling.tools import comm_overlap_fraction
 
 pytestmark = pytest.mark.skipif(
     not native.available(), reason=f"native core unavailable: {native.build_error()}")
+
+#: overlap floors are scheduling-timing dependent (ADVICE.md round-5
+#: item 5): legitimate on a dedicated box, flaky on shared CI hosts
+perf_sensitive = pytest.mark.skipif(
+    os.environ.get("PARSEC_TPU_PERF_ASSERTS", "1") == "0",
+    reason="perf-sensitive overlap floor disabled "
+           "(PARSEC_TPU_PERF_ASSERTS=0, shared host)")
 
 
 def test_stencil_overlap_fraction_from_trace(tmp_path):
@@ -83,12 +91,14 @@ def test_stencil_overlap_fraction_from_trace(tmp_path):
           f"busy {busy_us / 1e3:.1f} ms")
 
 
+@perf_sensitive
 def test_stencil_overlap_mesh_scale_floor():
     """Round-5 (VERDICT #3): the NAMED overlap config — 2D5pt stencil
     halo exchange — at mesh scale (4 ranks here; the dryrun runs 8) with
     device chores, via the shared measure_overlap helper.  Floors the
-    fraction at 0.3: measured 1.00 on the round-5 host, and a change
-    that serializes halo comm against compute must fail loudly."""
+    PER-RANK mean at 0.3 (each rank's comm vs its own compute — no
+    longer the union artifact that read 1.00 regardless): a change that
+    serializes halo comm against compute must fail loudly."""
     import sys
 
     sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
@@ -98,6 +108,7 @@ def test_stencil_overlap_mesh_scale_floor():
     assert stats["tasks"] == 6 * 8 * 4
     assert stats["activations"] > 0
     assert stats["overlap_fraction"] >= 0.3, stats
-    print(f"4-rank stencil overlap: {stats['overlap_fraction']:.2f} "
+    print(f"4-rank stencil overlap mean {stats['overlap_fraction']:.2f} "
+          f"min {stats['overlap_min']:.2f} "
           f"({stats['n_comm_events']} comm events, "
           f"{stats['tasks_per_s']} tasks/s)")

@@ -7,7 +7,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from parsec_tpu.parallel._compat import no_vma_check_kwargs, shard_map
 
 from parsec_tpu.parallel import (
     best_grid,
@@ -60,7 +60,8 @@ def test_collective_wrappers():
 
     x = jnp.arange(8.0).reshape(8, 1)
     f = shard_map(kern, mesh=mesh, in_specs=P("x", None),
-                  out_specs=(P("x", None), P(None, None)), check_vma=False)
+                  out_specs=(P("x", None), P(None, None)),
+                  **no_vma_check_kwargs())
     s, g = jax.jit(f)(x)
     assert float(np.asarray(s)[0, 0]) == 28.0
     np.testing.assert_allclose(np.asarray(g).ravel(), np.arange(8.0))

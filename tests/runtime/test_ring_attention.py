@@ -70,6 +70,10 @@ def test_ring_attention_long_context_memory_shape(mesh):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.skipif(
+    tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 5),
+    reason="pallas interpret mode inside shard_map lowers a PartitionId "
+           "op old-jax SPMD partitioning cannot place")
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_pallas_block_matches_dense(mesh, causal):
     """The fused Pallas block-update path (interpret mode on CPU) is
