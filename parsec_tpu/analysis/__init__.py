@@ -1,0 +1,44 @@
+"""Static analysis for PTG/JDF task graphs (``ptg-lint``).
+
+Ahead-of-time verification of parameterized task graphs — edge
+reciprocity, data-hazard detection, deadlock/liveness, expression and
+affinity lint — without executing a single task body.  The jdfc-compiler
+sanity-check analogue for this framework's runtime-built PTGs.
+
+Entry points:
+
+* :func:`verify_ptg` / ``PTG.verify(globals_, level=...)`` — verify a
+  definition against concrete globals; returns :class:`Finding` objects
+  with stable ``PTGxxx`` codes;
+* :func:`lint_jdf` — verify a compiled ``.jdf`` (run automatically by
+  ``jdfc.generate``);
+* ``python -m parsec_tpu.profiling.tools lint`` — the CLI (`--all`
+  sweeps the in-repo :mod:`.registry`);
+* ``PARSEC_TPU_LINT=1|strict`` — verify every PTG taskpool at attach;
+* :mod:`.edges` — the declared-DAG enumeration shared with the runtime
+  :class:`parsec_tpu.profiling.checkers.IteratorsChecker`, so static and
+  dynamic checkers can never disagree about the declared edges.
+"""
+
+from .findings import CODES, ERROR, WARNING, Finding, LintError, errors_of
+from .linter import (
+    SynthCollection,
+    collection_names,
+    lint_jdf,
+    synthesize_collections,
+    verify_ptg,
+)
+
+__all__ = [
+    "CODES",
+    "ERROR",
+    "WARNING",
+    "Finding",
+    "LintError",
+    "SynthCollection",
+    "collection_names",
+    "errors_of",
+    "lint_jdf",
+    "synthesize_collections",
+    "verify_ptg",
+]

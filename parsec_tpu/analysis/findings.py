@@ -1,0 +1,134 @@
+"""Finding model for the ahead-of-time PTG/JDF graph verifier.
+
+The reference's ``jdfc`` compiler rejects malformed ``.jdf`` graphs at
+compile time (unconnected flows, unbound locals — ``jdf.c:jdf_sanity_checks``).
+Findings here carry the same role for the runtime-built PTGs: a stable
+error code, a severity, and the offending task class / flow / parameter
+binding, so tools (``tools lint``, ``jdfc --strict``, ``PARSEC_TPU_LINT``)
+and tests can key on codes instead of message text.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+ERROR = "error"
+WARNING = "warning"
+
+#: stable code -> (severity, one-line description).  Codes are append-only:
+#: tools and user suppressions (``ignore=("PTG021",)``) depend on them.
+CODES = {
+    "PTG001": (ERROR, "output dependency has no reciprocal input on the "
+                      "consumer flow"),
+    "PTG002": (ERROR, "input dependency has no reciprocal output on the "
+                      "producer flow (asymmetric deps: the consumer would "
+                      "hang or hit a repo miss)"),
+    "PTG010": (ERROR, "write-after-write hazard: two tasks write the same "
+                      "collection tile with no dependency path between them"),
+    "PTG011": (ERROR, "unordered read/write hazard (RAW/WAR): a read of a "
+                      "collection tile races a write with no dependency path"),
+    "PTG020": (ERROR, "dependency cycle: the instantiated task DAG cannot "
+                      "be topologically ordered"),
+    "PTG021": (ERROR, "no input dependency matches: with static guards the "
+                      "task can never fire (add an explicit '<- NONE' "
+                      "fallback, or ignore this code for dynamic guards)"),
+    "PTG022": (WARNING, "ambiguous input: more than one guard-true non-NONE "
+                        "input dependency (single-assignment: first wins)"),
+    "PTG030": (ERROR, "unbound symbol in a dependency/range/affinity/"
+                      "priority expression"),
+    "PTG031": (ERROR, "collection key out of bounds for the collection's "
+                      "declared tile grid"),
+    "PTG032": (ERROR, "unknown collection in a data reference"),
+    "PTG033": (ERROR, "bad task reference: unknown task class, unknown "
+                      "flow, or wrong argument count"),
+    "PTG034": (ERROR, "range expression in a data-flow input argument "
+                      "(data inputs are single-assignment scalars)"),
+    "PTG035": (WARNING, "readable flow declares no input dependencies"),
+    "PTG040": (WARNING, "write-back target is owned by a different rank "
+                        "than the task's affinity (extra cross-rank "
+                        "traffic)"),
+    "PTG050": (WARNING, "parameter space exceeds the lint cap; "
+                        "instance-level checks were skipped"),
+    "PTG051": (ERROR, "graph instantiation failed while evaluating "
+                      "dependency expressions"),
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One verifier diagnostic.
+
+    ``task``/``flow``/``env`` locate the finding: the task class name, the
+    flow name, and the concrete parameter binding (locals tuple) of the
+    first offending instance (``None`` for purely static findings).
+    ``dep`` is the offending dependency's source text when one exists
+    (for hazard findings, which have no single dep, it anchors the
+    conflicting collection tile instead), ``count`` how many instances
+    exhibited the same defect (findings are deduplicated per
+    (code, task, flow, dep))."""
+
+    code: str
+    message: str
+    task: Optional[str] = None
+    flow: Optional[str] = None
+    env: Optional[Tuple] = None
+    dep: Optional[str] = None
+    count: int = 1
+
+    @property
+    def severity(self) -> str:
+        return CODES.get(self.code, (ERROR, ""))[0]
+
+    @property
+    def is_error(self) -> bool:
+        return self.severity == ERROR
+
+    def __str__(self) -> str:
+        where = ""
+        if self.task is not None:
+            where = self.task
+            if self.env is not None:
+                where += repr(tuple(self.env))
+            if self.flow is not None:
+                where += f".{self.flow}"
+            where = f" {where}:"
+        dep = f" [{self.dep}]" if self.dep else ""
+        more = f" (+{self.count - 1} more instance(s))" if self.count > 1 else ""
+        return f"{self.code} {self.severity}:{where} {self.message}{dep}{more}"
+
+
+class LintError(ValueError):
+    """Raised by strict-mode entry points (``jdfc --strict``,
+    ``PARSEC_TPU_LINT=strict``) when the verifier reports findings."""
+
+    def __init__(self, msg: str, findings):
+        super().__init__(msg)
+        self.findings = list(findings)
+
+
+def dedup(findings) -> "list[Finding]":
+    """Collapse identical defects found on many instances into one
+    finding carrying the first instance's env and a count."""
+    out = []
+    index = {}
+    for f in findings:
+        # instance findings (env set) collapse per offending dep — their
+        # messages embed the concrete instance; static findings (env
+        # None) keep the message in the key, since one class can carry
+        # several distinct static defects on the same location
+        key = (f.code, f.task, f.flow, f.dep,
+               f.message if f.env is None else None)
+        i = index.get(key)
+        if i is None:
+            index[key] = len(out)
+            out.append(f)
+        else:
+            prev = out[i]
+            out[i] = Finding(prev.code, prev.message, prev.task, prev.flow,
+                             prev.env, prev.dep, prev.count + 1)
+    return out
+
+
+def errors_of(findings):
+    return [f for f in findings if f.is_error]

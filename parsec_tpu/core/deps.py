@@ -88,6 +88,20 @@ class DepTracker:
                 e = table[key] = DepEntry()
             e.data = data
 
+    def pending_keys(self) -> list:
+        """Keys with partially-released counters/masks (entries are
+        deleted on fire, so after a clean quiesce this is empty).  A
+        non-empty result after wait() means some task was released by a
+        strict subset of its producers — the runtime signature of the
+        asymmetric-deps bugs the static verifier flags as PTG001/PTG002;
+        consumed by ``IteratorsChecker.verify``."""
+        out = []
+        for lock, table in self._shards:
+            with lock:
+                out.extend(k for k, e in table.items()
+                           if e.count != 0 or e.mask != 0)
+        return out
+
     def __len__(self) -> int:
         return sum(len(t) for _, t in self._shards)
 
@@ -221,6 +235,23 @@ class DenseDepTracker:
                 self._data[key] = data
             return
         self._fallback.set_data(key, data)
+
+    def pending_keys(self) -> list:
+        """Dense-side keys with partially-released slots plus the hash
+        fallback's pending keys (see ``DepTracker.pending_keys``)."""
+        out = self._fallback.pending_keys()
+        for name, (bounds, arr, _modes) in self._classes.items():
+            dims = [hi - lo + 1 for lo, hi in bounds]
+            for idx, v in enumerate(arr):
+                if v == 0:
+                    continue
+                locs = []
+                rem = idx
+                for d, (lo, _hi) in zip(reversed(dims), reversed(bounds)):
+                    locs.append(rem % d + lo)
+                    rem //= d
+                out.append((name, tuple(reversed(locs))))
+        return out
 
     def __len__(self) -> int:
         n = len(self._fallback)

@@ -36,6 +36,20 @@ from .ptg import (
 TaskId = Tuple[str, Tuple]  # (class name, locals)
 
 
+class PTGDefinitionView:
+    """Duck-typed stand-in for a ``PTGTaskpool`` carrying only what
+    :func:`capture` reads (``.ptg`` and ``.constants``) — lets the static
+    verifier capture a bare PTG definition against concrete globals
+    without instantiating a taskpool (no dep trackers, repos, taskpool
+    ids, or MCA parameter registration)."""
+
+    __slots__ = ("ptg", "constants")
+
+    def __init__(self, ptg, constants: Dict[str, Any]):
+        self.ptg = ptg
+        self.constants = dict(constants)
+
+
 class TaskNode:
     __slots__ = ("tid", "priority", "rank", "in_edges", "out_edges", "flow_sources", "write_backs")
 
@@ -115,6 +129,43 @@ class TaskGraph:
         finally:
             g.close()
         return [tids[i] for i in order]
+
+
+def find_cycle(g: TaskGraph) -> List[TaskId]:
+    """One concrete dependency cycle of the captured DAG, or ``[]`` when
+    the graph is acyclic.  Runs Kahn first (cheap), then walks the
+    leftover subgraph — every node surviving peeling sits on or behind a
+    cycle, so an iterative DFS from any of them must close one."""
+    indeg = {tid: n.in_edges for tid, n in g.nodes.items()}
+    frontier = [tid for tid, d in indeg.items() if d == 0]
+    while frontier:
+        tid = frontier.pop()
+        for (_f, succ, _sf) in g.nodes[tid].out_edges:
+            indeg[succ] -= 1
+            if indeg[succ] == 0:
+                frontier.append(succ)
+    stuck = {tid for tid, d in indeg.items() if d > 0}
+    if not stuck:
+        return []
+    # every stuck node has at least one stuck PREDECESSOR (its residual
+    # in-degree comes from an unpeeled producer), so walking predecessors
+    # always closes a cycle — stuck SUCCESSORS need not exist (a node
+    # merely downstream of a cycle is stuck too, and may be a sink)
+    pred: Dict[TaskId, TaskId] = {}
+    for tid in stuck:
+        for (_f, succ, _sf) in g.nodes[tid].out_edges:
+            if succ in stuck and succ not in pred:
+                pred[succ] = tid
+    path: List[TaskId] = []
+    on_path: Dict[TaskId, int] = {}
+    tid = min(stuck)  # deterministic pick
+    while tid not in on_path:
+        on_path[tid] = len(path)
+        path.append(tid)
+        tid = pred[tid]
+    cycle = path[on_path[tid]:]
+    cycle.reverse()  # predecessor walk found it backwards
+    return cycle
 
 
 def capture(tp: PTGTaskpool, ranks: Optional[Iterable[int]] = None) -> TaskGraph:

@@ -631,6 +631,17 @@ class JDF:
         return ptg
 
     # ------------------------------------------------------------------
+    def verify(self, globals_: Optional[Dict[str, Any]] = None, **kw):
+        """Ahead-of-time graph verification of the compiled JDF (see
+        ``PTG.verify`` / docs/USERGUIDE.md "Linting your graph").
+        Without ``globals_`` only the static source-level checks run,
+        judged against the declared JDF globals; with concrete globals
+        the full instance checks (reciprocity, hazards, cycles,
+        liveness) run.  Returns a list of findings (empty = clean)."""
+        from ..analysis import lint_jdf
+
+        return lint_jdf(self, globals_, **kw)
+
     def required_globals(self) -> List[str]:
         return [g.name for g in self.ast.globals if not g.has_default]
 

@@ -102,6 +102,17 @@ def exists_eval_count() -> int:
     return _exists_evals
 
 
+def reset_exists_eval_count() -> int:
+    """Zero the process-global existence-predicate work counter and
+    return the value it had.  Tests that pin scaling laws on the counter
+    (tests/dsl/test_exists_stress.py) reset it per measurement so work
+    from earlier taskpools in the same process cannot bleed in."""
+    global _exists_evals
+    old = _exists_evals
+    _exists_evals = 0
+    return old
+
+
 def _c_to_py(src: str) -> str:
     """Accept the C boolean operators of reference JDF expressions
     (``parsec.y`` expr grammar): ``&&`` → ``and``, ``||`` → ``or``,
@@ -260,14 +271,18 @@ def _parse_target(s: str):
 class _Dep:
     """One guarded dependency (reference ``jdf_dep_t``)."""
 
-    __slots__ = ("is_input", "guard", "then", "otherwise", "props")
+    __slots__ = ("is_input", "guard", "then", "otherwise", "props", "src")
 
-    def __init__(self, is_input, guard, then, otherwise=None, props=None):
+    def __init__(self, is_input, guard, then, otherwise=None, props=None,
+                 src=""):
         self.is_input = is_input
         self.guard = guard
         self.then = then
         self.otherwise = otherwise
         self.props = props or {}
+        #: original dependency source text — diagnostics (analysis
+        #: findings, runtime errors) point at the exact offending dep
+        self.src = src
 
     def target(self, env: Dict[str, Any]):
         if self.guard is None:
@@ -277,6 +292,7 @@ class _Dep:
 
 def _parse_dep(spec: str) -> _Dep:
     spec = spec.strip()
+    orig = spec
     props: Dict[str, str] = {}
     pm = re.search(r"\[(.*?)\]\s*$", spec)
     if pm:
@@ -318,10 +334,10 @@ def _parse_dep(spec: str) -> _Dep:
         branches = _split_top(qparts[1], ":")
         then = _parse_target(branches[0])
         otherwise = _parse_target(branches[1]) if len(branches) == 2 else None
-        return _Dep(is_input, guard, then, otherwise, props)
+        return _Dep(is_input, guard, then, otherwise, props, src=orig)
     if len(qparts) > 2:
         raise ValueError(f"bad ternary in {spec!r}")
-    return _Dep(is_input, None, _parse_target(rest), None, props)
+    return _Dep(is_input, None, _parse_target(rest), None, props, src=orig)
 
 
 def _expand_args(args: Sequence[_ArgExpr], env: Dict[str, Any]) -> Iterable[Tuple]:
@@ -638,6 +654,59 @@ class PTG:
         merged.update(constants)
         return PTGTaskpool(self, merged, termdet=termdet)
 
+    def verify(self, globals_: Optional[Dict[str, Any]] = None, *,
+               level: str = "full", ignore: Sequence[str] = (),
+               known: Optional[Iterable[str]] = None,
+               collections: Optional[set] = None,
+               max_tasks: Optional[int] = None,
+               **more: Any):
+        """Ahead-of-time graph verification (the jdfc sanity-check
+        analogue): enumerate the parameter space under the given concrete
+        globals WITHOUT executing any task body and check edge
+        reciprocity, data hazards, cycles/liveness, and expression/
+        affinity sanity.  Returns a list of
+        :class:`parsec_tpu.analysis.Finding` (empty = clean).
+
+        ``level``: ``"full"`` (default) runs every check; ``"static"``
+        runs only source-level lint (no parameter-space enumeration —
+        usable before concrete problem sizes are known).  ``ignore``
+        suppresses finding codes (e.g. ``("PTG021",)`` for graphs with
+        dynamic guards, whose held-back tasks are released at runtime by
+        their producers).  ``known``/``collections`` name the symbols a
+        later taskpool() call will supply (without them, a no-globals
+        static verify treats every referenced symbol as known — a bare
+        PTG declares its globals only implicitly, so unbound-symbol
+        checks need either concrete globals or a declared name set).
+        ``max_tasks`` caps the instance enumeration (PTG050 beyond it).
+        Extra keyword arguments are graph globals, mirroring
+        ``taskpool(**constants)``.  See ``docs/USERGUIDE.md`` "Linting
+        your graph"."""
+        from ..analysis import verify_ptg
+        from ..analysis.linter import collection_names, free_symbols
+
+        kw: Dict[str, Any] = {"level": level, "ignore": ignore}
+        if max_tasks is not None:
+            kw["max_tasks"] = max_tasks
+        if globals_ is None and not more:
+            # no concrete globals: static-only lint of the definition.
+            # The symbol/collection universe comes from the caller, or
+            # defaults to "everything the definition references" —
+            # structural checks (PTG033/034/035) still run in full.
+            if known is None:
+                known = free_symbols(self) | set(self.constants)
+            if collections is None:
+                collections = collection_names(self)
+            return verify_ptg(self, None, known=known,
+                              collections=collections, **kw)
+        if known is not None:
+            kw["known"] = known
+        if collections is not None:
+            kw["collections"] = collections
+        merged = dict(self.constants)
+        merged.update(globals_ or {})
+        merged.update(more)
+        return verify_ptg(self, merged, **kw)
+
 
 # ---------------------------------------------------------------------------
 # the instantiated taskpool (what jdf2c generates)
@@ -714,6 +783,7 @@ class PTGTaskpool(Taskpool):
         return n
 
     def attached(self, context) -> None:
+        self._maybe_lint()
         if isinstance(self.deps, DenseDepTracker):
             # dense mode: class boxes must be registered before ANY
             # release (a counter split across the hash fallback and the
@@ -733,6 +803,36 @@ class PTGTaskpool(Taskpool):
             if n_wb:
                 self.tdm.taskpool_addto_runtime_actions(self, n_wb)
         super().attached(context)
+
+    def _maybe_lint(self) -> None:
+        """Opt-in startup verification (``PARSEC_TPU_LINT``): ``1``/``warn``
+        prints findings to stderr and continues; ``strict``/``2`` raises
+        on error-severity findings before any task is scheduled.
+        ``PARSEC_TPU_LINT_IGNORE`` suppresses codes (comma/space
+        separated, e.g. ``PTG021`` for dynamic-guard graphs, whose
+        held-back tasks are legitimate) so strict mode stays usable on
+        apps with a documented false positive.  Off by default — the
+        verifier re-enumerates the parameter space, which is lint-scale
+        work, not production-attach work."""
+        import os
+
+        mode = os.environ.get("PARSEC_TPU_LINT", "").strip().lower()
+        if mode in ("", "0", "off"):
+            return
+        from ..analysis import verify_ptg
+        from ..analysis.findings import LintError, errors_of
+        from ..utils import debug
+
+        ignore = tuple(
+            c for c in os.environ.get("PARSEC_TPU_LINT_IGNORE", "")
+            .replace(",", " ").split() if c)
+        findings = verify_ptg(self.ptg, self.constants, ignore=ignore)
+        for f in findings:
+            debug.warning("lint %s: %s", self.ptg.name, f)
+        if mode in ("strict", "2") and errors_of(findings):
+            raise LintError(
+                f"PARSEC_TPU_LINT=strict: taskpool {self.ptg.name} has "
+                f"{len(errors_of(findings))} lint error(s)", findings)
 
     # -- vtable construction (the jdf2c analogue) ------------------------
     def _build_class(self, pc: PTGTaskClass) -> None:

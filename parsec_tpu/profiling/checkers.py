@@ -71,17 +71,20 @@ class IteratorsChecker:
 
     # -- verification ------------------------------------------------------
     def verify(self, ptg_tp, rank: Optional[int] = None) -> List[str]:
-        """Compare observations against the captured DAG of ``ptg_tp``.
-        Returns the list of inconsistencies (empty = clean)."""
-        from ..dsl.graph import capture
+        """Compare observations against the declared DAG of ``ptg_tp``.
+        Returns the list of inconsistencies (empty = clean).
+
+        The declared edges come from the SAME enumeration the static
+        verifier uses (:mod:`parsec_tpu.analysis.edges`), so the runtime
+        checker and ``ptg-lint`` can never disagree about what the
+        declared dependency structure is."""
+        from ..analysis.edges import declared_dag, declared_edge_set
 
         if rank is None:
             rank = ptg_tp.context.rank if ptg_tp.context else 0
-        g = capture(ptg_tp, ranks=[rank])
+        g = declared_dag(ptg_tp, ranks=[rank])
         declared: Set[Tuple] = set(g.nodes)
-        edges: Set[Tuple[Tuple, Tuple]] = {
-            (tid, succ) for tid, n in g.nodes.items() for (_f, succ, _sf) in n.out_edges
-        }
+        edges: Set[Tuple[Tuple, Tuple]] = declared_edge_set(g)
         errors: List[str] = []
         with self._lock:
             executed = [(c, l) for (tp, c, l) in self.executed if tp == ptg_tp.taskpool_id]
@@ -111,5 +114,14 @@ class IteratorsChecker:
             got = release_count.get(tid, 0)
             if got != expect:
                 errors.append(f"task {tid} released {got} times (expected {expect})")
+        # after a clean quiesce every dependency counter has fired and
+        # been deleted; a leftover is a task released by only a strict
+        # subset of its producers (the runtime signature of the
+        # asymmetric-deps defects ptg-lint reports as PTG001/PTG002)
+        pending = getattr(ptg_tp.deps, "pending_keys", lambda: [])()
+        if pending:
+            errors.append(
+                f"dependency counters still pending for {sorted(pending)[:5]}"
+                f" ({len(pending)} total): partial release / missed fire")
         self.errors = errors
         return errors

@@ -17,7 +17,12 @@ module is the equivalent over the framework's Chrome/Perfetto JSON traces:
   ``dbpreader`` mode; see ``profiling/merge.py``);
 * ``critpath`` — reconstruct the task-dependency critical path from a
   (merged) trace and attribute its wall time to compute / comm /
-  host-scheduling-gap buckets per task class (``profiling/critpath.py``).
+  host-scheduling-gap buckets per task class (``profiling/critpath.py``);
+* ``lint``    — the ahead-of-time PTG/JDF graph verifier
+  (:mod:`parsec_tpu.analysis`): edge reciprocity, data hazards,
+  deadlock/liveness, expression/affinity lint — without executing a
+  single task body.  Targets are ``.jdf`` files, ``module:callable``
+  builders returning a PTG, or in-repo registry names (``--all``).
 
 Usage::
 
@@ -27,6 +32,11 @@ Usage::
         --expect MPI_ACTIVATE:nb=100 --expect MPI_DATA_PLD:lensum=209715200
     python -m parsec_tpu.profiling.tools merge rank*.pbt -o merged.json
     python -m parsec_tpu.profiling.tools critpath merged.json
+    python -m parsec_tpu.profiling.tools lint examples/jdf/cholesky.jdf \
+        -D NT=4 --strict
+    python -m parsec_tpu.profiling.tools lint \
+        parsec_tpu.ops.cholesky:cholesky_ptg -D NT=4
+    python -m parsec_tpu.profiling.tools lint --all
 """
 
 from __future__ import annotations
@@ -244,6 +254,125 @@ def cmd_critpath(args) -> int:
     return 0 if report["n_tasks"] else 1
 
 
+def _parse_defines(defs) -> Dict[str, Any]:
+    """``-D NAME=VALUE`` pairs; values are Python literals when they
+    parse as one (``-D NT=4``, ``-D SHAPE='(2,2)'``), strings otherwise."""
+    import ast as _ast
+
+    out: Dict[str, Any] = {}
+    for d in defs or []:
+        name, eq, val = d.partition("=")
+        if not eq or not name.strip():
+            raise SystemExit(f"bad -D {d!r}: want NAME=VALUE")
+        try:
+            out[name.strip()] = _ast.literal_eval(val)
+        except (ValueError, SyntaxError):
+            out[name.strip()] = val
+    return out
+
+
+def _lint_one(target: str, overrides: Dict[str, Any], ignore):
+    """Resolve one lint target -> (display name, findings, notes)."""
+    import importlib
+    import os
+
+    from ..analysis import lint_jdf, synthesize_collections, verify_ptg
+
+    notes: List[str] = []
+    if target.endswith(".jdf") or os.path.isfile(target):
+        from ..dsl.jdf import compile_jdf_file
+
+        jdf = compile_jdf_file(target)
+        consts = dict(jdf.ptg.constants)
+        consts.update(overrides)
+        consts, synth = synthesize_collections(jdf.ptg, consts)
+        if synth:
+            notes.append(f"synthesized collection(s): {', '.join(synth)}")
+        missing = [g.name for g in jdf.ast.globals
+                   if not g.has_default and g.name not in consts]
+        if missing:
+            notes.append(f"missing globals {missing} (pass -D NAME=VALUE): "
+                         "static checks only")
+            return target, lint_jdf(jdf, ignore=ignore), notes
+        return target, lint_jdf(jdf, consts, ignore=ignore), notes
+    if ":" in target:
+        from ..analysis.linter import collection_names, free_symbols
+
+        mod_name, _, fn_name = target.partition(":")
+        fn = getattr(importlib.import_module(mod_name), fn_name)
+        ptg = fn() if callable(fn) else fn
+        consts = dict(ptg.constants)
+        consts.update(overrides)
+        consts, synth = synthesize_collections(ptg, consts)
+        if synth:
+            notes.append(f"synthesized collection(s): {', '.join(synth)}")
+        missing = sorted(free_symbols(ptg) - set(consts))
+        if missing:
+            # a builder PTG declares its globals only implicitly: lint
+            # statically against the full referenced-symbol universe
+            # instead of flagging every unsupplied scalar as unbound
+            # (mirrors the .jdf path's missing-globals fallback)
+            notes.append(f"missing globals {missing} (pass -D NAME=VALUE): "
+                         "static checks only")
+            findings = verify_ptg(
+                ptg, None, level="static",
+                known=free_symbols(ptg) | set(consts),
+                collections=collection_names(ptg), ignore=ignore)
+            return target, findings, notes
+        return target, verify_ptg(ptg, consts, ignore=ignore), notes
+    from ..analysis import registry
+
+    ptg, consts = registry.build(target)
+    consts = dict(consts)
+    consts.update(overrides)
+    return target, verify_ptg(ptg, consts, ignore=ignore), notes
+
+
+def cmd_lint(args) -> int:
+    """Ahead-of-time graph verifier CLI (see parsec_tpu.analysis)."""
+    from ..analysis import errors_of
+    from ..analysis import registry
+
+    ignore = tuple(c for arg in (args.ignore or [])
+                   for c in arg.split(",") if c)
+    targets = list(args.targets or [])
+    if args.all:
+        targets.extend(registry.names())
+        targets = list(dict.fromkeys(targets))  # explicit + --all overlap
+    if not targets:
+        print("lint: no targets (pass .jdf files, module:callable specs, "
+              f"registry names, or --all; registry: {registry.names()})",
+              file=sys.stderr)
+        return 2
+    overrides = _parse_defines(args.define)
+    n_err = n_warn = 0
+    failed = False
+    for target in targets:
+        try:
+            name, findings, notes = _lint_one(target, overrides, ignore)
+        except Exception as e:
+            print(f"{target}: FAILED to build/parse: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            failed = True
+            continue
+        for note in notes:
+            print(f"{name}: note: {note}")
+        for f in findings:
+            print(f"{name}: {f}")
+        errs = len(errors_of(findings))
+        n_err += errs
+        n_warn += len(findings) - errs
+        if not findings:
+            print(f"{name}: OK")
+    print(f"lint: {len(targets)} graph(s), {n_err} error(s), "
+          f"{n_warn} warning(s)")
+    if failed or n_err:
+        return 1
+    if args.strict and n_warn:
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="parsec_tpu.profiling.tools",
@@ -281,6 +410,25 @@ def main(argv=None) -> int:
     pp.add_argument("--json", action="store_true",
                     help="emit the raw report as JSON")
     pp.set_defaults(fn=cmd_critpath)
+    pl = sub.add_parser(
+        "lint", help="ahead-of-time PTG/JDF graph verifier: edge "
+        "reciprocity, data hazards, deadlock/liveness, expression lint "
+        "— no task body executes")
+    pl.add_argument("targets", nargs="*",
+                    help=".jdf file, module:callable returning a PTG, or "
+                    "in-repo registry name")
+    pl.add_argument("-D", "--define", action="append", metavar="NAME=VALUE",
+                    help="bind a graph global (Python literal or string; "
+                    "repeatable); undeclared collections are synthesized")
+    pl.add_argument("--all", action="store_true",
+                    help="also lint every in-repo graph "
+                    "(parsec_tpu.analysis.registry)")
+    pl.add_argument("--strict", action="store_true",
+                    help="exit non-zero on warnings too, not just errors")
+    pl.add_argument("--ignore", action="append", metavar="CODES",
+                    help="comma-separated finding codes to suppress "
+                    "(e.g. PTG021 for dynamic-guard graphs)")
+    pl.set_defaults(fn=cmd_lint)
     args = p.parse_args(argv)
     return args.fn(args)
 

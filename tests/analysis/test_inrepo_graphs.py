@@ -1,0 +1,32 @@
+"""Tier-1 CI wiring: every in-repo PTG builder (ops) and example ``.jdf``
+must verify to ZERO findings (warnings included).  A dependency
+regression in any shipped graph fails here long before it shows up as a
+runtime hang — the acceptance criterion of ISSUE 2."""
+
+import pytest
+
+from parsec_tpu.analysis import registry, verify_ptg
+
+
+@pytest.mark.parametrize("name", registry.names())
+def test_inrepo_graph_lints_clean(name):
+    ptg, consts = registry.build(name)
+    findings = verify_ptg(ptg, consts)
+    assert findings == [], \
+        f"{name}: " + "; ".join(str(f) for f in findings)
+
+
+def test_registry_covers_examples_and_ops():
+    names = registry.names()
+    assert any(n.startswith("ops.") for n in names)
+    assert any(n.startswith("jdf.") for n in names)
+    # the flagship graphs are pinned by name so a registry edit cannot
+    # silently drop them from CI
+    for pinned in ("ops.cholesky", "ops.segmented_lu", "jdf.cholesky",
+                   "jdf.stencil_1d"):
+        assert pinned in names, f"registry lost {pinned}"
+
+
+def test_registry_unknown_name():
+    with pytest.raises(KeyError):
+        registry.build("no.such.graph")

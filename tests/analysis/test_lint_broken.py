@@ -1,0 +1,313 @@
+"""One deliberately-broken graph per verifier error code, asserting the
+stable code AND the reported location (task class / flow / env) — the
+contract tools and CI key on (ISSUE 2 satellite: per-code coverage)."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.analysis import CODES, Finding, verify_ptg
+from parsec_tpu.core.lifecycle import AccessMode
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.datadist.matrix import TiledMatrix
+from parsec_tpu.dsl.ptg import PTG
+
+IN = AccessMode.IN
+OUT = AccessMode.OUT
+INOUT = AccessMode.INOUT
+
+
+def _codes(findings):
+    return {f.code for f in findings}
+
+
+def _find(findings, code):
+    hits = [f for f in findings if f.code == code]
+    assert hits, f"no {code} in {[str(f) for f in findings]}"
+    return hits[0]
+
+
+def _chain(n=3):
+    """A well-formed 2-class chain to mutate per test: prod(k) feeds
+    cons(k) on flow X."""
+    ptg = PTG("broken")
+    prod = ptg.task_class("prod", k=f"0 .. {n - 1}")
+    prod.affinity("D(k)")
+    prod.flow("X", INOUT, "<- D(k)", "-> X cons(k)")
+    cons = ptg.task_class("cons", k=f"0 .. {n - 1}")
+    cons.affinity("D(k)")
+    cons.flow("X", IN, "<- X prod(k)")
+    return ptg
+
+
+def test_clean_baseline():
+    assert _chain().verify({"D": LocalCollection("D")}) == []
+
+
+def test_ptg001_missing_reciprocal_input():
+    """The acceptance-criteria case: the consumer's reciprocal input dep
+    is removed (it reads the collection instead) — the producer's output
+    release would be unaccounted."""
+    ptg = PTG("broken")
+    prod = ptg.task_class("prod", k="0 .. 2")
+    prod.affinity("D(k)")
+    prod.flow("X", INOUT, "<- D(k)", "-> X cons(k)")
+    cons = ptg.task_class("cons", k="0 .. 2")
+    cons.affinity("D(k)")
+    cons.flow("X", IN, "<- D(k)")  # should be '<- X prod(k)'
+    f = _find(ptg.verify({"D": LocalCollection("D")}), "PTG001")
+    assert f.task == "prod" and f.flow == "X" and f.env == (0,)
+    assert f.is_error and f.count == 3
+    assert "cons" in f.message
+
+
+def test_ptg002_missing_reciprocal_output():
+    ptg = PTG("broken")
+    prod = ptg.task_class("prod", k="0 .. 2")
+    prod.affinity("D(k)")
+    prod.flow("X", INOUT, "<- D(k)", "-> D(k)")  # no '-> X cons(k)'
+    cons = ptg.task_class("cons", k="0 .. 2")
+    cons.affinity("D(k)")
+    cons.flow("Y", IN, "<- X prod(k)")
+    f = _find(ptg.verify({"D": LocalCollection("D")}), "PTG002")
+    assert f.task == "cons" and f.flow == "Y" and f.env == (0,)
+    assert "prod" in f.message
+
+
+def test_ptg010_waw_race():
+    ptg = PTG("waw")
+    for name in ("w1", "w2"):
+        tc = ptg.task_class(name, k="0 .. 0")
+        tc.affinity("D(0)")
+        tc.flow("X", INOUT, "<- D(0)")  # both mutate tile D(0), unordered
+    fs = ptg.verify({"D": LocalCollection("D")})
+    f = _find(fs, "PTG010")
+    assert "D(0,)" in f.message and "w1" in f.message and "w2" in f.message
+
+
+def test_ptg011_unordered_read_write():
+    ptg = PTG("raw")
+    w = ptg.task_class("writer", k="0 .. 0")
+    w.affinity("D(0)")
+    w.flow("X", INOUT, "<- D(0)")
+    r = ptg.task_class("reader", k="0 .. 0")
+    r.affinity("D(0)")
+    r.flow("X", IN, "<- D(0)")  # no dependency path to/from writer
+    f = _find(ptg.verify({"D": LocalCollection("D")}), "PTG011")
+    assert f.task == "reader" and f.flow == "X" and f.env == (0,)
+    assert "writer" in f.message
+
+
+def test_ptg020_cycle():
+    ptg = PTG("cyc")
+    a = ptg.task_class("a", k="0 .. 0")
+    a.affinity("D(0)")
+    a.flow("X", INOUT, "<- Y b(k)", "-> Y b(k)")
+    b = ptg.task_class("b", k="0 .. 0")
+    b.affinity("D(0)")
+    b.flow("Y", INOUT, "<- X a(k)", "-> X a(k)")
+    f = _find(ptg.verify({"D": LocalCollection("D")}), "PTG020")
+    assert "cycle" in f.message
+    assert f.task in ("a", "b") and f.env == (0,)
+
+
+def test_ptg021_never_fires():
+    ptg = PTG("dead")
+    a = ptg.task_class("a", k="0 .. 2")
+    a.affinity("D(0)")
+    a.flow("X", IN, "<- (k > 99) ? D(0)")  # no branch ever matches
+    f = _find(ptg.verify({"D": LocalCollection("D")}), "PTG021")
+    assert f.task == "a" and f.flow == "X" and f.env == (0,) and f.count == 3
+    # dynamic-guard escape hatch: the code is suppressible
+    assert ptg.verify({"D": LocalCollection("D")}, ignore=("PTG021",)) == []
+
+
+def test_ptg022_ambiguous_input_warns():
+    ptg = PTG("ambig")
+    a = ptg.task_class("a", k="0 .. 1")
+    a.affinity("D(k)")
+    a.flow("X", IN, "<- D(k)", "<- (k == 0) ? D(k)")  # both match at k=0
+    f = _find(ptg.verify({"D": LocalCollection("D")}), "PTG022")
+    assert f.severity == "warning" and f.env == (0,) and f.count == 1
+
+
+def test_ptg030_unbound_symbol():
+    ptg = PTG("unbound")
+    a = ptg.task_class("a", k="0 .. ZZ")  # ZZ never supplied
+    a.affinity("D(0)")
+    a.flow("X", IN, "<- D(qq)")  # qq unbound
+    fs = ptg.verify({"D": LocalCollection("D")})
+    assert _codes(fs) == {"PTG030"}
+    assert any("ZZ" in f.message and f.task == "a" for f in fs)
+    assert any("qq" in f.message and f.flow == "X" for f in fs)
+
+
+def test_ptg031_out_of_bounds_key():
+    A = TiledMatrix(8, 8, 2, 2)  # 4 x 4 tiles
+    ptg = PTG("oob")
+    a = ptg.task_class("a", k="0 .. 3")
+    a.affinity("A(k, k+1)")  # k=3 -> (3, 4): off the grid
+    a.flow("X", IN, "<- A(k, k)")
+    f = _find(ptg.verify({"A": A}), "PTG031")
+    assert f.task == "a" and f.env == (3,)
+    assert "(3, 4)" in f.message
+
+
+def test_ptg032_unknown_collection():
+    ptg = PTG("noc")
+    a = ptg.task_class("a", k="0 .. 1")
+    a.affinity("D(0)")
+    a.flow("X", IN, "<- NOSUCH(k)")
+    f = _find(ptg.verify({"D": LocalCollection("D")}), "PTG032")
+    assert f.task == "a" and f.flow == "X" and "NOSUCH" in f.message
+
+
+def test_ptg033_bad_task_reference():
+    ptg = PTG("badref")
+    a = ptg.task_class("a", k="0 .. 1")
+    a.affinity("D(0)")
+    a.flow("X", IN, "<- Q nope(k)")      # unknown class
+    a.flow("Y", IN, "<- X a(k, 1)")      # arity mismatch
+    a.flow("Z", OUT, "-> W a(k)")        # consumer has no flow W
+    fs = ptg.verify({"D": LocalCollection("D")})
+    msgs = [f.message for f in fs if f.code == "PTG033"]
+    assert len(msgs) == 3
+    assert any("nope" in m for m in msgs)
+    assert any("2 argument(s)" in m for m in msgs)
+    assert any("no flow 'W'" in m for m in msgs)
+
+
+def test_ptg034_range_in_data_input():
+    ptg = PTG("rng")
+    a = ptg.task_class("a", k="0 .. 1")
+    a.affinity("D(0)")
+    a.flow("X", IN, "<- X a(0 .. k)")
+    f = _find(ptg.verify({"D": LocalCollection("D")}), "PTG034")
+    assert f.task == "a" and f.flow == "X"
+
+
+def test_ptg035_readable_flow_without_inputs():
+    ptg = PTG("noin")
+    a = ptg.task_class("a", k="0 .. 1")
+    a.affinity("D(0)")
+    a.flow("X", IN)
+    f = _find(ptg.verify({"D": LocalCollection("D")}), "PTG035")
+    assert f.severity == "warning" and f.flow == "X"
+
+
+def test_ptg040_cross_rank_writeback():
+    class TwoRank(LocalCollection):
+        def rank_of(self, *key):
+            return int(key[0]) % 2
+
+    ptg = PTG("xrank")
+    a = ptg.task_class("a", k="0 .. 1")
+    a.affinity("D(0)")  # every task on rank 0...
+    a.flow("X", INOUT, "<- D(k)", "-> D(k)")  # ...but k=1 writes rank 1
+    fs = ptg.verify({"D": TwoRank("D", nodes=2)})
+    f = _find(fs, "PTG040")
+    assert f.severity == "warning" and f.env == (1,)
+
+
+def test_ptg050_param_space_cap():
+    ptg = PTG("huge")
+    a = ptg.task_class("a", k="0 .. 9999")
+    a.affinity("D(0)")
+    a.flow("X", INOUT, "<- D(0)")
+    fs = verify_ptg(ptg, {"D": LocalCollection("D")}, max_tasks=100)
+    assert _codes(fs) == {"PTG050"}
+
+
+def test_every_code_is_documented():
+    """Codes are append-only and every emitted code must be in CODES."""
+    emitted = {"PTG001", "PTG002", "PTG010", "PTG011", "PTG020", "PTG021",
+               "PTG022", "PTG030", "PTG031", "PTG032", "PTG033", "PTG034",
+               "PTG035", "PTG040", "PTG050", "PTG051"}
+    assert emitted <= set(CODES)
+    for code, (sev, desc) in CODES.items():
+        assert sev in ("error", "warning") and desc
+    # Finding severity falls back to error for unknown codes
+    assert Finding("PTG999", "x").severity == "error"
+
+
+def test_static_level_and_known_names():
+    """level='static' needs no concrete globals: unbound symbols are
+    judged against the caller-declared names."""
+    ptg = PTG("stat")
+    a = ptg.task_class("a", k="0 .. NT-1")
+    a.affinity("A(k)")
+    a.flow("X", INOUT, "<- A(k)", "-> A(k)")
+    fs = verify_ptg(ptg, None, level="static", known={"NT"},
+                    collections={"A"})
+    assert fs == []
+    fs = verify_ptg(ptg, None, level="static", known=set(),
+                    collections={"A"})
+    assert _codes(fs) == {"PTG030"}
+    with pytest.raises(ValueError):
+        verify_ptg(ptg, None, level="nope")
+
+
+def test_ignore_accepts_bare_string():
+    ptg = PTG("dead2")
+    a = ptg.task_class("a", k="0 .. 2")
+    a.affinity("D(0)")
+    a.flow("X", IN, "<- (k > 99) ? D(0)")
+    assert ptg.verify({"D": LocalCollection("D")}, ignore="PTG021") == []
+
+
+def test_hazard_pass_has_explicit_work_budget(monkeypatch):
+    """A chain where every task writes ONE tile is the quadratic worst
+    case for the hazard pass: under a tiny budget it reports PTG050
+    instead of grinding (no silent cap, no hang)."""
+    from parsec_tpu.analysis import linter
+
+    ptg = PTG("chain_haz")
+    t = ptg.task_class("t", k="0 .. 49")
+    t.affinity("D(0)")
+    t.flow("X", INOUT,
+           "<- (k == 0) ? D(0) : X t(k-1)",
+           "-> (k == 49) ? D(0) : X t(k+1)")
+    consts = {"D": LocalCollection("D")}
+    assert ptg.verify(consts) == []  # within budget: fully checked
+    monkeypatch.setattr(linter, "HAZARD_WORK_LIMIT", 10)
+    fs = ptg.verify(consts)
+    assert [f.code for f in fs] == ["PTG050"]
+    assert "hazard" in fs[0].message
+
+
+def test_ptg051_instantiation_failure_is_a_finding_not_a_crash():
+    """Expressions that only fail at instantiation time (statically
+    clean: every symbol is known) become PTG051 findings."""
+    ptg = PTG("boom")
+    a = ptg.task_class("a", k="0 .. NT // ZERO")  # ZeroDivisionError
+    a.affinity("D(0)")
+    a.flow("X", INOUT, "<- D(0)", "-> D(0)")
+    fs = ptg.verify({"NT": 4, "ZERO": 0, "D": LocalCollection("D")})
+    f = _find(fs, "PTG051")
+    assert "ZeroDivisionError" in f.message
+
+
+def test_ignoring_a_static_code_does_not_skip_instance_checks():
+    """ignore applies before the static-error gate: suppressing PTG030
+    must not silently certify the graph — the broken evaluation
+    surfaces as PTG051 instead of a clean report."""
+    ptg = PTG("gated")
+    a = ptg.task_class("a", k="0 .. ZZ")  # ZZ unbound -> PTG030
+    a.affinity("D(0)")
+    a.flow("X", INOUT, "<- D(0)", "-> D(0)")
+    consts = {"D": LocalCollection("D")}
+    assert _codes(ptg.verify(consts)) == {"PTG030"}
+    fs = ptg.verify(consts, ignore=("PTG030",))
+    assert fs and _codes(fs) == {"PTG051"}  # anything but a clean []
+
+
+def test_hazard_findings_on_distinct_tiles_do_not_collapse():
+    ptg = PTG("two_tiles")
+    for name in ("w1", "w2"):
+        tc = ptg.task_class(name, k="0 .. 0")
+        tc.affinity("D(0)")
+        tc.flow("X", INOUT, "<- D(0)")
+        tc.flow("Y", INOUT, "<- E(0)")
+    fs = ptg.verify({"D": LocalCollection("D"), "E": LocalCollection("E")})
+    waw = [f for f in fs if f.code == "PTG010"]
+    assert len(waw) == 2
+    assert {f.dep for f in waw} == {"D(0,)", "E(0,)"}

@@ -1,0 +1,162 @@
+"""Clean-graph coverage: the flagship ops builders verify to zero
+findings, and the opt-in runtime hooks (``PTG.verify``, the
+``PARSEC_TPU_LINT`` startup lint) behave as documented."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.analysis import LintError, verify_ptg
+from parsec_tpu.analysis.linter import SynthCollection, synthesize_collections
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.datadist.matrix import TiledMatrix
+from parsec_tpu.dsl.ptg import PTG
+from parsec_tpu.core.lifecycle import AccessMode
+
+IN = AccessMode.IN
+INOUT = AccessMode.INOUT
+
+
+def test_cholesky_builder_is_clean():
+    from parsec_tpu.ops.cholesky import cholesky_ptg
+
+    A = TiledMatrix(8, 8, 2, 2)
+    assert cholesky_ptg(use_tpu=False).verify({"NT": 4, "A": A}) == []
+
+
+def test_segmented_lu_builder_is_clean():
+    from parsec_tpu.ops.segmented_chol import n_segments
+    from parsec_tpu.ops.segmented_lu import segmented_lu_ptg
+
+    ptg = segmented_lu_ptg(8, 4, tail=4)
+    consts = {"NT": n_segments(8, 4, tail=4), "A": LocalCollection("A")}
+    assert ptg.verify(consts) == []
+
+
+def test_verify_accepts_kwargs_and_merges_ptg_constants():
+    ptg = PTG("kw", NT=3)
+    a = ptg.task_class("a", k="0 .. NT-1")
+    a.affinity("D(k)")
+    a.flow("X", INOUT, "<- D(k)", "-> D(k)")
+    # globals may arrive as a dict, as kwargs, or live on the PTG itself
+    assert ptg.verify(D=LocalCollection("D")) == []
+    assert ptg.verify({"D": LocalCollection("D")}, level="static") == []
+
+
+def test_synthesize_collections():
+    ptg = PTG("syn")
+    a = ptg.task_class("a", k="0 .. 1")
+    a.affinity("D(k)")
+    a.flow("X", INOUT, "<- D(k)", "-> E(k)")
+    consts, added = synthesize_collections(ptg, {"NT": 2})
+    assert added == ["D", "E"]
+    assert all(isinstance(consts[n], SynthCollection) for n in added)
+    assert consts["D"].rank_of(5) == 0
+    with pytest.raises(RuntimeError):
+        consts["D"].data_of(0)
+    assert verify_ptg(ptg, consts) == []
+
+
+def _broken_pool():
+    ptg = PTG("broken_env")
+    prod = ptg.task_class("prod", k="0 .. 1")
+    prod.affinity("D(k)")
+    prod.flow("X", INOUT, "<- D(k)", "-> X cons(k)")
+    cons = ptg.task_class("cons", k="0 .. 1")
+    cons.affinity("D(k)")
+    cons.flow("X", IN, "<- D(k)")  # missing reciprocal input
+    return ptg.taskpool(D=LocalCollection("D"))
+
+
+def test_env_lint_off_by_default(monkeypatch):
+    monkeypatch.delenv("PARSEC_TPU_LINT", raising=False)
+    _broken_pool()._maybe_lint()  # no-op
+    monkeypatch.setenv("PARSEC_TPU_LINT", "0")
+    _broken_pool()._maybe_lint()
+
+
+def test_env_lint_warn_mode_does_not_raise(monkeypatch, capsys):
+    monkeypatch.setenv("PARSEC_TPU_LINT", "1")
+    from parsec_tpu.utils import debug
+
+    debug.set_verbose(2)
+    try:
+        _broken_pool()._maybe_lint()
+    finally:
+        debug.set_verbose(1)
+
+
+def test_env_lint_strict_mode_raises(monkeypatch):
+    monkeypatch.setenv("PARSEC_TPU_LINT", "strict")
+    with pytest.raises(LintError) as ei:
+        _broken_pool()._maybe_lint()
+    assert any(f.code == "PTG001" for f in ei.value.findings)
+
+
+def test_env_lint_strict_passes_clean_pool(monkeypatch):
+    monkeypatch.setenv("PARSEC_TPU_LINT", "strict")
+    ptg = PTG("clean_env")
+    a = ptg.task_class("a", k="0 .. 1")
+    a.affinity("D(k)")
+    a.flow("X", INOUT, "<- D(k)", "-> D(k)")
+    ptg.taskpool(D=LocalCollection("D"))._maybe_lint()
+
+
+def test_strict_lint_runs_end_to_end_in_context(monkeypatch):
+    """The startup hook fires from Context.add_taskpool: a broken PTG is
+    rejected before a single task is scheduled."""
+    monkeypatch.setenv("PARSEC_TPU_LINT", "strict")
+    from parsec_tpu import Context
+
+    ctx = Context(nb_cores=1)
+    try:
+        with pytest.raises(LintError):
+            ctx.add_taskpool(_broken_pool())
+    finally:
+        monkeypatch.delenv("PARSEC_TPU_LINT")
+        ctx.fini()
+
+
+def test_static_verify_of_builder_ptg_without_globals_is_clean():
+    """A builder PTG declares its globals only implicitly: a no-globals
+    static verify must not flag them as unbound (code-review fix) —
+    structural checks still run."""
+    from parsec_tpu.ops.cholesky import cholesky_ptg
+
+    assert cholesky_ptg(use_tpu=False).verify(level="static") == []
+    # structural defects ARE still caught without globals
+    ptg = PTG("structbad")
+    a = ptg.task_class("a", k="0 .. NT-1")
+    a.affinity("D(k)")
+    a.flow("X", IN, "<- Q nope(k)")
+    codes = {f.code for f in ptg.verify(level="static")}
+    assert codes == {"PTG033"}
+    # an explicit known set reinstates the unbound-symbol check
+    codes = {f.code for f in ptg.verify(level="static", known=set(),
+                                        collections={"D"})}
+    assert "PTG030" in codes
+
+
+def test_verify_forwards_lint_kwargs_not_as_globals():
+    """max_tasks/known/collections are lint parameters, never graph
+    globals (code-review fix: they used to be silently swallowed)."""
+    ptg = PTG("cap")
+    a = ptg.task_class("a", k="0 .. 999")
+    a.affinity("D(0)")
+    a.flow("X", INOUT, "<- D(0)", "-> D(0)")
+    fs = ptg.verify({"D": LocalCollection("D")}, max_tasks=10)
+    assert {f.code for f in fs} == {"PTG050"}
+
+
+def test_env_lint_ignore_keeps_strict_usable(monkeypatch):
+    """PARSEC_TPU_LINT_IGNORE: a dynamic-guard app (documented PTG021
+    false positive) can keep strict mode on for every other code."""
+    ptg = PTG("dyn")
+    a = ptg.task_class("a", k="0 .. 1")
+    a.affinity("D(0)")
+    a.flow("X", IN, "<- (k > 99) ? D(0)")  # PTG021 under static guards
+    tp = ptg.taskpool(D=LocalCollection("D"))
+    monkeypatch.setenv("PARSEC_TPU_LINT", "strict")
+    with pytest.raises(LintError):
+        tp._maybe_lint()
+    monkeypatch.setenv("PARSEC_TPU_LINT_IGNORE", "PTG021, PTG040")
+    tp._maybe_lint()  # suppressed: the pool is allowed to start

@@ -1,0 +1,179 @@
+"""CLI surfaces of the verifier: ``tools lint`` and the ``jdfc``
+``--lint``/``--strict`` flag pair + non-zero exits on parse errors."""
+
+import pytest
+
+from parsec_tpu.dsl import jdfc
+from parsec_tpu.profiling import tools
+
+CLEAN_JDF = """\
+A  [ type = "collection" ]
+NB [ type = int ]
+
+Task(k)
+k = 0 .. NB
+: A( k )
+RW X <- (k == 0)  ? A( k ) : X Task( k-1 )
+     -> (k == NB) ? A( k ) : X Task( k+1 )
+BODY
+  pass
+END
+"""
+
+# the acceptance-criteria mutation: the reciprocal input edge is removed
+# (the consumer reads its tile from the collection instead of the chain)
+BROKEN_JDF = CLEAN_JDF.replace(
+    "RW X <- (k == 0)  ? A( k ) : X Task( k-1 )",
+    "RW X <- A( k )")
+
+SYNTAX_ERR_JDF = "Task(k\n"
+
+
+@pytest.fixture
+def jdf_files(tmp_path):
+    paths = {}
+    for name, text in (("clean", CLEAN_JDF), ("broken", BROKEN_JDF),
+                       ("syntax", SYNTAX_ERR_JDF)):
+        p = tmp_path / f"{name}.jdf"
+        p.write_text(text)
+        paths[name] = str(p)
+    return paths
+
+
+# -- tools lint --------------------------------------------------------------
+
+def test_lint_clean_jdf_exits_zero(jdf_files, capsys):
+    rc = tools.main(["lint", jdf_files["clean"], "-D", "NB=3", "--strict"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "OK" in out and "synthesized collection(s): A" in out
+
+
+def test_lint_broken_jdf_reports_ptg001_and_fails_strict(jdf_files, capsys):
+    rc = tools.main(["lint", jdf_files["broken"], "-D", "NB=3", "--strict"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    # exact task class, flow and env, no task body ever executed
+    assert "PTG001" in out and "Task(1,)" in out and ".X" in out
+
+
+def test_lint_broken_jdf_fails_even_without_strict(jdf_files):
+    assert tools.main(["lint", jdf_files["broken"], "-D", "NB=3"]) == 1
+
+
+def test_lint_missing_globals_falls_back_to_static(jdf_files, capsys):
+    rc = tools.main(["lint", jdf_files["clean"]])
+    out = capsys.readouterr().out
+    assert rc == 0 and "missing globals" in out and "['NB']" in out
+
+
+def test_lint_module_builder_target(capsys):
+    rc = tools.main(["lint", "parsec_tpu.ops.cholesky:cholesky_ptg",
+                     "-D", "NT=3"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "OK" in out
+
+
+def test_lint_registry_name_target(capsys):
+    assert tools.main(["lint", "jdf.chaindata"]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_lint_ignore_suppresses_codes(jdf_files):
+    rc = tools.main(["lint", jdf_files["broken"], "-D", "NB=3",
+                     "--ignore", "PTG001,PTG011"])
+    assert rc == 0
+
+
+def test_lint_no_targets_is_usage_error(capsys):
+    assert tools.main(["lint"]) == 2
+
+
+def test_lint_unparsable_target_fails(jdf_files, capsys):
+    rc = tools.main(["lint", jdf_files["syntax"]])
+    assert rc == 1
+    assert "FAILED" in capsys.readouterr().err
+
+
+# -- jdfc --------------------------------------------------------------------
+
+def test_jdfc_parse_error_exits_nonzero_without_traceback(jdf_files, capsys):
+    rc = jdfc.main([jdf_files["syntax"], "-o", "/dev/null"])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "jdfc:" in err and "Traceback" not in err
+
+
+def test_jdfc_missing_file_exits_nonzero(capsys):
+    assert jdfc.main(["/no/such/file.jdf"]) == 1
+    assert "jdfc:" in capsys.readouterr().err
+
+
+def test_jdfc_lint_flag_clean(jdf_files, capsys):
+    rc = jdfc.main(["--lint", jdf_files["clean"]])
+    assert rc == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_jdfc_lint_flag_static_error(tmp_path, capsys):
+    # an unbound symbol IS visible statically (no globals needed)
+    p = tmp_path / "unbound.jdf"
+    p.write_text(CLEAN_JDF.replace("k = 0 .. NB", "k = 0 .. MISSING"))
+    rc = jdfc.main(["--lint", str(p)])
+    assert rc == 1
+    assert "PTG030" in capsys.readouterr().err
+
+
+def test_jdfc_generate_emits_despite_warnings_unless_strict(tmp_path, capsys):
+    p = tmp_path / "unbound.jdf"
+    p.write_text(CLEAN_JDF.replace("k = 0 .. NB", "k = 0 .. MISSING"))
+    out = tmp_path / "gen.py"
+    rc = jdfc.main([str(p), "-o", str(out)])
+    captured = capsys.readouterr()
+    assert rc == 0 and out.exists()          # findings are warnings...
+    assert "PTG030" in captured.err          # ...printed to stderr
+    out2 = tmp_path / "gen2.py"
+    rc = jdfc.main([str(p), "-o", str(out2), "--strict"])
+    assert rc == 1 and not out2.exists()     # --strict fails the build
+
+
+def test_jdfc_generate_clean_roundtrip(jdf_files, tmp_path, capsys):
+    out = tmp_path / "task_ptg.py"
+    rc = jdfc.main([jdf_files["clean"], "-o", str(out)])
+    captured = capsys.readouterr()
+    assert rc == 0 and out.exists()
+    assert "PTG" not in captured.err  # clean graph: silent stderr
+
+
+def test_jdf_verify_method():
+    """JDF.verify mirrors PTG.verify: static without globals, full with."""
+    from parsec_tpu.data import LocalCollection
+    from parsec_tpu.dsl.jdf import compile_jdf
+
+    jdf = compile_jdf(BROKEN_JDF, "broken")
+    assert jdf.verify() == []  # reciprocity needs concrete globals
+    findings = jdf.verify({"NB": 3, "A": LocalCollection("A")})
+    assert any(f.code == "PTG001" for f in findings)
+    clean = compile_jdf(CLEAN_JDF, "clean")
+    assert clean.verify({"NB": 3, "A": LocalCollection("A")}) == []
+
+
+def test_jdfc_unwritable_output_exits_nonzero(jdf_files, capsys):
+    rc = jdfc.main([jdf_files["clean"], "-o", "/nonexistent/dir/out.py"])
+    assert rc == 1
+    assert "jdfc:" in capsys.readouterr().err
+
+
+def test_lint_module_builder_without_globals_falls_back_to_static(capsys):
+    rc = tools.main(["lint", "parsec_tpu.ops.cholesky:cholesky_ptg"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "missing globals" in out and "NT" in out and "OK" in out
+
+
+def test_lint_all_dedups_explicit_targets(capsys):
+    rc = tools.main(["lint", "jdf.chaindata", "--all"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    from parsec_tpu.analysis import registry
+    assert f"lint: {len(registry.names())} graph(s)" in out

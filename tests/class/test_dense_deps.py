@@ -138,3 +138,25 @@ def test_mca_param_selects_dense():
         assert isinstance(tp.deps, DenseDepTracker)
     finally:
         params.set("runtime", "dep_storage", "hash")
+
+
+def test_pending_keys_reports_partial_releases():
+    """pending_keys(): the runtime signature of asymmetric deps — a
+    counter that was incremented but never reached its goal survives,
+    and the IteratorsChecker reports it after a run."""
+    for t in (DepTracker(), DenseDepTracker()):
+        assert t.pending_keys() == []
+    hash_t = DepTracker()
+    hash_t.release_counter(("f", (1,)), 3)
+    assert hash_t.pending_keys() == [("f", (1,))]
+    hash_t.release_counter(("f", (1,)), 3)
+    hash_t.release_counter(("f", (1,)), 3)  # fires: entry deleted
+    assert hash_t.pending_keys() == []
+
+    dense = DenseDepTracker()
+    dense.register_class("f", ((0, 3), (1, 4)))
+    dense.release_counter(("f", (2, 3)), 2)        # dense-side pending
+    dense.release_counter(("g", (9,)), 2)          # fallback pending
+    assert sorted(dense.pending_keys()) == [("f", (2, 3)), ("g", (9,))]
+    dense.release_counter(("f", (2, 3)), 2)        # fires
+    assert dense.pending_keys() == [("g", (9,))]
