@@ -71,17 +71,29 @@ def _minus_cost(t: float, c: float) -> float:
 def _record(fields: dict, key: str, gflops: float) -> None:
     """Append one measured sample for a headline field and maintain the
     in-artifact spread (round-4 VERDICT Weak #3: single-sample fields
-    carry no error bar): ``key`` stays the BEST sample (back-compat with
-    earlier artifacts), ``key_reps`` lists every sample of this run, and
-    ``key_med`` is their median — so one artifact shows both the
-    capability number and how much the tunnel moved between samples."""
+    carry no error bar).  Round 6 (VERDICT r05 Weak #5): the quoted
+    number ``key`` is the MEDIAN of this run's samples — under the
+    documented 3-4x tunnel jitter a best-of-reps headline reads the
+    tunnel, not the framework.  Bests survive in ``key_best`` and the
+    full ``key_reps`` array; ``key_med`` is kept equal to ``key`` for
+    tooling that reads the old field name."""
     reps = fields.setdefault(f"{key}_reps", [])
     reps.append(round(gflops, 2))
-    fields[key] = max(reps)
+    fields[f"{key}_best"] = max(reps)
     sr = sorted(reps)
     mid = len(sr) // 2
-    fields[f"{key}_med"] = round(
-        sr[mid] if len(sr) % 2 else (sr[mid - 1] + sr[mid]) / 2, 2)
+    med = round(sr[mid] if len(sr) % 2 else (sr[mid - 1] + sr[mid]) / 2, 2)
+    fields[key] = fields[f"{key}_med"] = med
+
+
+def _dpotrf_ntasks(n: int, nb: int) -> int:
+    """Task count of the dpotrf PTG at NT tiles: potrf NT, trsm + syrk
+    NT(NT-1)/2 each, gemm NT(NT-1)(NT-2)/6.  One definition feeds BOTH
+    tasks/s A/B legs so the headline ratio can never compare counts from
+    drifted formulas.  NT is the CEILING tile count — TiledMatrix pads a
+    ragged edge into an extra tile row/column (mt = ceil(n/nb))."""
+    nt = (n + nb - 1) // nb
+    return nt + nt * (nt - 1) + nt * (nt - 1) * (nt - 2) // 6
 
 
 def _leg(fields: dict, name: str, fn) -> bool:
@@ -313,7 +325,7 @@ def _rest_of_main(N, NB, dtype, backend, on_accel, reps, rtt,
             tpu_dev = next((d for d in ctx.devices if d.mca_name == "tpu"),
                            None)
             dev_tiles = {}
-            if on_accel and tpu_dev is not None:
+            if tpu_dev is not None:
                 A0 = TiledMatrix(N, N, NB, NB, name="A",
                                  dtype=dtype).from_array(SPD)
                 for i in range(A0.mt):
@@ -329,8 +341,11 @@ def _rest_of_main(N, NB, dtype, backend, on_accel, reps, rtt,
                     d = A.data_of(i, j)
                     c = d.attach_copy(tpu_dev.data_index, arr)
                     c.version = d.newest_copy().version
-                tp = cholesky_ptg(use_tpu=on_accel,
-                                  use_cpu=not on_accel).taskpool(NT=A.mt, A=A)
+                # device chores on EVERY backend (the jax CPU device in
+                # smoke runs): both sides of the tasks/s A/B must measure
+                # the same chore class, or the ratio compares paths
+                tp = cholesky_ptg(use_tpu=True,
+                                  use_cpu=False).taskpool(NT=A.mt, A=A)
                 t0 = time.perf_counter()
                 ctx.add_taskpool(tp)
                 ok = tp.wait(timeout=1800)
@@ -356,7 +371,12 @@ def _rest_of_main(N, NB, dtype, backend, on_accel, reps, rtt,
                 return _minus_cost(dt, rtt)
 
             dynamic_once()  # warmup: per-shape kernel compiles
-            fields["dynamic_gflops"] = round(flops / dynamic_once() / 1e9, 2)
+            t_dyn = dynamic_once()
+            fields["dynamic_gflops"] = round(flops / t_dyn / 1e9, 2)
+            # tasks/s: the dispatch-rate axis of the native-dispatch A/B
+            # (BASELINE round 6) — same task count as the native leg
+            fields["dynamic_tasks_per_s"] = round(
+                _dpotrf_ntasks(N, NB) / t_dyn, 1)
 
             # observability leg: one EXTRA (untimed) run under the
             # per-rank tracer, then the critical-path analyzer attributes
@@ -409,6 +429,69 @@ def _rest_of_main(N, NB, dtype, backend, on_accel, reps, rtt,
 
     if not _over_budget(0.85, "dynamic stage"):
         _leg(fields, "dynamic", dynamic_leg)
+
+    # ---- STAGE 3b: NATIVE device dispatch (the round-6 tentpole) -------
+    # Same dynamic-class problem (many small tasks), but the hot loop is
+    # the C++ engine: chores return ASYNC, the TpuDevice manager (waves,
+    # lanes) dispatches, and pz_task_done releases successors natively —
+    # no per-task Python for prepare_input/release_deps/scheduling (the
+    # ~0.5 ms/task cost the round-5 wave A/B pinned).  Target (VERDICT
+    # round-5 #1): >= 5x dynamic_gflops (>= 3 TF) at N=8192 nb=512.
+    def dynamic_native_leg():
+        from parsec_tpu.dsl.native_exec import NativeExecutor
+
+        ntasks = _dpotrf_ntasks(N, NB)
+        share = {"dev": None}
+
+        def native_once() -> float:
+            A = TiledMatrix(N, N, NB, NB, name="A",
+                            dtype=dtype).from_array(SPD)
+            # device chores + native dispatch on EVERY backend (jax CPU
+            # device in smoke runs) — the leg must measure the ASYNC-
+            # chore/pz_task_done path it is named for, and match the
+            # dynamic leg's chore class for an honest A/B
+            tp = cholesky_ptg(use_tpu=True,
+                              use_cpu=False).taskpool(NT=A.mt, A=A)
+            # capture + graph build stay OUTSIDE the timed region — like
+            # the graph path's construction (and the reference's
+            # compile-time structures); the timed region is
+            # ready-to-quiesce execution, matching the dynamic leg's
+            # add_taskpool..wait window
+            ex = NativeExecutor(tp, native_device=True,
+                                device=share["dev"])
+            share["dev"] = ex.device  # reuse jit cache across reps
+            t0 = time.perf_counter()
+            ran = ex.run(nthreads=int(os.environ.get("BENCH_CORES", "4")))
+            last = A.data_of(A.mt - 1, A.nt - 1).newest_copy()
+            if last is not None and hasattr(last.payload, "ravel"):
+                try:
+                    sync_scalar(last.payload)
+                except Exception:
+                    pass
+            dt = time.perf_counter() - t0
+            if ran != ntasks:
+                raise RuntimeError(
+                    f"native-dispatch run retired {ran}/{ntasks} tasks")
+            Lt = np.asarray(jax.device_get(last.payload))
+            h = Lt.shape[0]
+            errn = np.max(np.abs(np.tril(Lt) - np.tril(L_ref[-h:, -h:])))
+            if not np.isfinite(errn) or errn / scale > 1e-3:
+                raise RuntimeError(f"native-dispatch numerics off ({errn})")
+            ex.close()
+            return _minus_cost(dt, rtt)
+
+        native_once()  # warmup: per-shape kernel + wave-program compiles
+        for _ in range(2):
+            t_n = native_once()
+            _record(fields, "dynamic_native_gflops", flops / t_n / 1e9)
+            _record(fields, "dynamic_native_tasks_per_s", ntasks / t_n)
+        if fields.get("dynamic_gflops"):
+            fields["dynamic_native_vs_python"] = round(
+                fields["dynamic_native_gflops"]
+                / fields["dynamic_gflops"], 2)
+
+    if not _over_budget(0.87, "dynamic native stage"):
+        _leg(fields, "dynamic_native", dynamic_native_leg)
 
     # ---- STAGE 4: QR / LU through the runtime --------------------------
     if on_accel and os.environ.get("BENCH_QRLU", "1") != "0" \

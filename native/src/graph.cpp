@@ -22,6 +22,15 @@
 // Streaming insertion (DTD style) is supported: add_task/add_dep may be
 // called while run() is live; quiescence is reached when every inserted
 // task has executed and the submitter called seal().
+//
+// ASYNC chores (the reference's PARSEC_HOOK_RETURN_ASYNC, scheduling.c
+// :126-153 + device_gpu.c:2510-2730): run_async() bodies return a status —
+// 0 means the body completed synchronously (the worker releases successors
+// inline, keep-next fast path intact), nonzero means a device manager took
+// ownership and completion arrives LATER through pz_task_done(task_id),
+// which runs release_deps natively from whatever thread calls it.  The
+// run does not quiesce until every async completion has been signalled;
+// pz_graph_fail() aborts a run whose completions can no longer arrive.
 
 #include <atomic>
 #include <condition_variable>
@@ -94,6 +103,21 @@ struct Graph {
 };
 
 using BodyFn = void (*)(int64_t task_id, int64_t user_tag, void* ctx);
+// async-capable body: returns 0 (done, complete inline) or nonzero
+// (ASYNC — a device manager owns completion, signalled via pz_task_done)
+using AsyncBodyFn = int32_t (*)(int64_t task_id, int64_t user_tag, void* ctx);
+
+// adapter so the legacy void-body entry reuses the async worker loop
+struct SyncBodyAdapter {
+    BodyFn body;
+    void* ctx;
+};
+
+int32_t sync_body_thunk(int64_t id, int64_t tag, void* ctx) {
+    SyncBodyAdapter* a = static_cast<SyncBodyAdapter*>(ctx);
+    a->body(id, tag, a->ctx);
+    return 0;
+}
 
 void push_global(Graph* g, int32_t prio, int64_t id) {
     {
@@ -214,7 +238,7 @@ bool all_done(Graph* g) {
                g->n_inserted.load(std::memory_order_acquire);
 }
 
-void worker_main(Graph* g, BodyFn body, void* ctx, int32_t wid) {
+void worker_main(Graph* g, AsyncBodyFn body, void* ctx, int32_t wid) {
     int64_t next = -1;  // kept successor from the previous completion
     for (;;) {
         int64_t id = next;
@@ -241,7 +265,12 @@ void worker_main(Graph* g, BodyFn body, void* ctx, int32_t wid) {
             std::lock_guard<std::mutex> lk(g->graph_mu);
             t = g->tasks[id];
         }
-        body(id, t->user_tag, ctx);
+        if (body(id, t->user_tag, ctx) != 0) {
+            // ASYNC: a device manager owns this task now; its completion
+            // (and successor release) arrives through pz_task_done — the
+            // worker just moves to the next ready task
+            continue;
+        }
         next = complete(g, id, wid);
         if (all_done(g)) g->ready_cv.notify_all();
     }
@@ -366,11 +395,8 @@ void pz_graph_seal(void* gp) {
     g->ready_cv.notify_all();
 }
 
-// Execute the graph with nthreads native workers. Returns the number of
-// executed tasks, or -1 if the graph did not quiesce (cycle or
-// uncommitted task detected at seal time).
-int64_t pz_graph_run(void* gp, BodyFn body, void* ctx, int32_t nthreads) {
-    Graph* g = static_cast<Graph*>(gp);
+// Shared run harness over the async-capable worker loop.
+int64_t run_workers(Graph* g, AsyncBodyFn body, void* ctx, int32_t nthreads) {
     if (nthreads < 1) nthreads = 1;
     if (g->policy.load(std::memory_order_relaxed) == POLICY_LFQ)
         g->wqs = std::vector<WorkerQ>(nthreads);
@@ -384,6 +410,69 @@ int64_t pz_graph_run(void* gp, BodyFn body, void* ctx, int32_t nthreads) {
     for (auto& th : ts) th.join();
     if (!all_done(g)) return -1;
     return g->n_executed.load(std::memory_order_acquire);
+}
+
+// Execute the graph with nthreads native workers. Returns the number of
+// executed tasks, or -1 if the graph did not quiesce (cycle or
+// uncommitted task detected at seal time).
+int64_t pz_graph_run(void* gp, BodyFn body, void* ctx, int32_t nthreads) {
+    SyncBodyAdapter a{body, ctx};
+    return run_workers(static_cast<Graph*>(gp), sync_body_thunk, &a, nthreads);
+}
+
+// Execute with an async-capable body: a nonzero body return means the
+// task's completion will be signalled later via pz_task_done (the
+// reference's ASYNC hook status — a device manager owns the task).  The
+// run blocks until every task, async ones included, has completed.
+int64_t pz_graph_run_async(void* gp, AsyncBodyFn body, void* ctx,
+                           int32_t nthreads) {
+    return run_workers(static_cast<Graph*>(gp), body, ctx, nthreads);
+}
+
+// Native completion entry for ASYNC tasks: runs release_deps (successor
+// counter decrements + ready-queue pushes) entirely natively, from ANY
+// thread (typically the device manager's completion callback — the
+// reference's complete_execution reached from the GPU manager,
+// device_gpu.c:2510-2730).  Returns 0 on success, -1 on a bad id, -2 if
+// the task had already completed (straggler callback after shutdown or a
+// double signal) — callers treat -2 as a harmless no-op at teardown.
+int pz_task_done(void* gp, int64_t id) {
+    Graph* g = static_cast<Graph*>(gp);
+    Task* t;
+    {
+        std::lock_guard<std::mutex> lk(g->graph_mu);
+        if (id < 0 || id >= static_cast<int64_t>(g->tasks.size())) return -1;
+        t = g->tasks[id];
+        // atomic claim: two racing signals for the same task must resolve
+        // to exactly one release pass (complete() re-stores done=true,
+        // which is idempotent)
+        if (t->done.exchange(true, std::memory_order_acq_rel)) return -2;
+    }
+    // wid = -1: the caller is not a worker, so newly-ready successors go
+    // to the shared queue; the "kept" successor has no worker to run on
+    // either — push it globally too
+    int64_t keep = complete(g, id, -1);
+    if (keep >= 0) {
+        int32_t prio;
+        {
+            std::lock_guard<std::mutex> lk(g->graph_mu);
+            prio = g->tasks[keep]->priority;
+        }
+        push_global(g, prio, keep);
+    }
+    // this may have been the LAST outstanding completion: wake sleepers
+    // so the run can quiesce even when no push happened
+    g->ready_cv.notify_all();
+    return 0;
+}
+
+// Abort a live run: completions that can no longer arrive (a failed
+// device pool) must not hang the workers forever.  Workers drain their
+// current body and exit; pz_graph_run*/run() then reports non-quiescence.
+void pz_graph_fail(void* gp) {
+    Graph* g = static_cast<Graph*>(gp);
+    g->failed.store(true, std::memory_order_release);
+    g->ready_cv.notify_all();
 }
 
 // Dispatch-bound benchmark entry: run with a native no-op body (no GIL

@@ -44,6 +44,16 @@ def _ops_cholesky(**kw):
     return build
 
 
+def _ops_cholesky_dynamic():
+    """The dynamic-class dpotrf exactly as the bench's dynamic and
+    native-dispatch legs capture it (device chores): the graph behind
+    ``dynamic_native_gflops`` is lint-swept like every shipped graph."""
+    from ..ops.cholesky import cholesky_ptg
+
+    return cholesky_ptg(use_tpu=True, use_cpu=False), \
+        {"NT": 4, "A": _tiled(4)}
+
+
 def _ops_lu():
     from ..ops.lu import lu_ptg
 
@@ -109,6 +119,7 @@ def _jdf(stem: str, consts: Callable[[], Dict]):
 GRAPHS: Dict[str, Callable[[], Tuple]] = {
     "ops.cholesky": _ops_cholesky(),
     "ops.cholesky_trtri": _ops_cholesky(use_trtri=True),
+    "ops.cholesky_dynamic": _ops_cholesky_dynamic,
     "ops.lu": _ops_lu,
     "ops.qr": _ops_qr,
     "ops.stencil": _ops_stencil,

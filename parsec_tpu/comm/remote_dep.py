@@ -41,6 +41,14 @@ def _fail_pool(tp, why: str) -> bool:
     pool must leave the context's active set, or ``Context.wait()`` would
     still hang on ``_active_taskpools`` even though ``tp.wait()`` returns.
     Returns True only on the terminating transition."""
+    # record the root cause BEFORE the terminating transition so whoever
+    # surfaces the failure (tp.wait() callers, the native executor's
+    # pool shim) can name it instead of a generic "failed (see log)"
+    if getattr(tp, "fail_reason", None) is None:
+        try:
+            tp.fail_reason = why
+        except Exception:
+            pass  # exotic pool types without settable attrs: log-only
     if not tp._force_fail():
         return False  # already terminated (normally or by an earlier failure)
     debug.error("taskpool %s failed: %s", tp.name, why)

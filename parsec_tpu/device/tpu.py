@@ -39,6 +39,7 @@ import numpy as np
 
 from ..core.lifecycle import AccessMode, HookReturn, DEV_TPU
 from ..core.task import Task
+from ..profiling import pins
 from ..utils import debug, mca_param, register_component
 from ..data.data import Coherency, Data, DataCopy
 from .device import Device
@@ -295,6 +296,20 @@ class TpuDevice(Device):
                     except Exception:
                         pass
 
+    @staticmethod
+    def _fire_exec(task: Task, site: str, wave: int = 0) -> None:
+        """EXEC_BEGIN/END for NATIVE-dispatched tasks (opt-in via the
+        ``pins_exec`` marker): on the dynamic path the scheduling core
+        wraps the chore hook in EXEC pins, but on the native path no
+        Python scheduling core exists — without these fires the trace
+        shows a host-gap hole exactly where device waves ran, and
+        ``profiling.critpath`` cannot attribute them.  Wave metadata
+        (chunk size; 0 = per-task submit) rides ``task.prof`` so
+        observers can tell batched dispatch from singles."""
+        if getattr(task, "pins_exec", False) and pins.active(site):
+            task.prof["wave"] = wave
+            pins.fire(site, None, task)
+
     def _submit_one(self, task: Task, es) -> None:
         """Per-task submit with the retry/fail-loudly discipline."""
         try:
@@ -447,7 +462,11 @@ class TpuDevice(Device):
                     return tuple(outs)
                 jitted = self._jit_cache[key] = jax.jit(_wave)
             flat = [a for (dargs, _, _) in gst for a in dargs]
+            for t in grp:
+                self._fire_exec(t, pins.EXEC_BEGIN, wave=cnt)
             outs = jitted(*flat)
+            for t in grp:
+                self._fire_exec(t, pins.EXEC_END, wave=cnt)
             if len(outs) != nout * cnt:
                 raise ValueError(
                     f"wave of {tasks[0].task_class.name}: bodies returned "
@@ -587,14 +606,18 @@ class TpuDevice(Device):
             # a donating call that raises may have invalidated its input
             # buffers: the task is no longer safely retryable
             task._tpu_effects = bool(donate)
+            self._fire_exec(task, pins.EXEC_BEGIN)
             outputs = jitted(*arr_args)
+            self._fire_exec(task, pins.EXEC_END)
         else:
             jitted = self._jit_cache.get(base_key)
             if jitted is None:
                 jitted = self._jit_cache[base_key] = jax.jit(
                     body, donate_argnums=donate)
             task._tpu_effects = bool(donate)
+            self._fire_exec(task, pins.EXEC_BEGIN)
             outputs = jitted(*dev_args)
+            self._fire_exec(task, pins.EXEC_END)
         if not isinstance(outputs, (tuple, list)):
             outputs = (outputs,)
         outputs = list(outputs)
@@ -794,6 +817,13 @@ class TpuDevice(Device):
             # corrupt the home tile; the host copy already holds the same
             # version in home layout (_stage_in_custom pre-flushes)
             return
+        hc = data.get_copy(0)
+        if hc is not None and hc.payload is not None and hc.version >= c.version:
+            # the host already holds this version OR NEWER (a CPU body
+            # consumed the device output and bumped past it — the mixed
+            # native_device DAG shape): flushing the stale device copy
+            # would roll the tile back
+            return
         host = np.asarray(c.payload)  # D2H
         if not host.flags.writeable:
             host = host.copy()  # host copies must be mutable for CPU bodies
@@ -932,6 +962,20 @@ class TpuDevice(Device):
             self._writeback(data)
         self._lru_dirty.clear()
         self._lru_clean.clear()
+        # release residency ACCOUNTING with the LRUs: the payloads stay
+        # attached to their Data objects (a later stage-in reuses them,
+        # unaccounted — same rule as externally pre-placed copies), but a
+        # slot no LRU tracks can never be evicted, so leaving it charged
+        # would leak phantom hbm_used across device reuse (the shared
+        # `device=` amortization pattern) until eviction stops working
+        if self._zone is not None:
+            for (off, _nb) in self._offsets.values():
+                self._zone.release(off)
+            self._offsets.clear()
+            self.hbm_used = self._zone.used
+        else:
+            self._accounted.clear()
+            self.hbm_used = 0
 
 
 def device_body(chore, fn):

@@ -9,10 +9,24 @@ native worker threads), and Python is entered once per task through a
 ctypes trampoline to run the BODY.  Dependency resolution, scheduling
 and termination detection never touch the interpreter.
 
-Scope: single-rank, CPU-chore bodies, in-place numpy tiles (the dynamic
-``Context`` path owns devices, reshape and multi-rank; the whole-DAG XLA
-lowering owns the TPU path).  This is the dispatch-bound regime — many
-small tasks — where interpreter overhead dominates the dynamic path.
+Scope: single-rank.  Two body regimes:
+
+* CPU chores (default) — in-place numpy tiles, Python entered once per
+  BODY through the trampoline;
+* **native device dispatch** (``native_device=True``) — classes with an
+  accelerator BODY hand their tasks to the :class:`TpuDevice` manager
+  (manager loop, async lanes, wave batching all intact) and the chore
+  returns ASYNC: the native worker moves on immediately, and the device
+  manager's completion callback signals ``pz_task_done(task_id)``, which
+  runs release_deps/ready-queue/termination *natively*.  Per task the
+  interpreter is entered exactly twice — the enqueue trampoline and the
+  completion callback — never for dependency bookkeeping (the reference
+  keeps device dispatch inside its native hot loop the same way,
+  ``scheduling.c:126-153`` + ``device_gpu.c:2510-2730``).
+
+This is the dispatch-bound regime — many small tasks — where
+interpreter overhead dominates the dynamic path (round-5 A/B: ~0.5
+ms/task of host-side Python bookkeeping).
 """
 
 from __future__ import annotations
@@ -23,10 +37,11 @@ import numpy as np
 
 import types
 
-from ..core.lifecycle import AccessMode, DEV_CPU
+from ..core.lifecycle import AccessMode, HookReturn, DEV_CPU, DEV_TPU
+from ..core.task import Chore, Task, TaskClass
 from ..profiling import pins
 from .graph import TaskGraph, capture, source_tile
-from .ptg import CTL, PTGTaskpool
+from .ptg import CTL, PTGTaskpool, _wrap_device_body
 
 
 class _TaskInfo:
@@ -44,6 +59,53 @@ class _TaskInfo:
         return self._r
 
 
+class _NativePoolShim:
+    """Stand-in taskpool for native-dispatched device tasks: carries the
+    failure contract the device layer needs (``failed`` checked before
+    every dispatch; ``_force_fail`` called by ``remote_dep._fail_pool``
+    on unrecoverable device errors) and aborts the native run so workers
+    cannot hang on completions that will never arrive."""
+
+    def __init__(self, executor: "NativeExecutor", name: str):
+        self._ex = executor
+        self.name = name
+        self.failed = False
+        self.fail_reason: Optional[str] = None
+        self.context = None
+
+    def _force_fail(self) -> bool:
+        if self.failed:
+            return False
+        self.failed = True
+        if self.fail_reason is None:  # _fail_pool threads the root cause in
+            self.fail_reason = "device submit/epilog failed (see error log)"
+        ng = getattr(self._ex, "_ng", None)
+        if ng is not None:
+            ng.fail()  # release the native workers
+        return True
+
+    def task_done(self, task=None) -> None:
+        pass  # quiescence is the native engine's, not a termdet's
+
+
+class _NativeDeviceTask(Task):
+    """Task instance handed to the device manager from the native path:
+    a real :class:`Task` (the device layer's staging, wave-signature and
+    epilog code read its slots unchanged) plus the native task id its
+    completion must signal and the PINS opt-in marker."""
+
+    __slots__ = ("native_id", "pins_exec")
+
+    def __init__(self, pool, tclass, locals_, priority):
+        super().__init__(pool, tclass, locals_, priority)
+        self.native_id = -1
+        #: tells TpuDevice to fire EXEC_BEGIN/END (with wave metadata in
+        #: ``prof``) around the actual device dispatch: on the native
+        #: path no scheduling core wraps the hook, so without this the
+        #: trace shows a host-gap hole where device waves ran
+        self.pins_exec = True
+
+
 class NativeExecutor:
     """Run a PTG taskpool's full DAG on the native engine.
 
@@ -51,9 +113,21 @@ class NativeExecutor:
     the declared write-backs to the backing collections, exactly like the
     dynamic runtime's CPU path.  The taskpool must be unstarted (never
     attached to a Context).
+
+    ``native_device=True`` routes every task class carrying an
+    accelerator BODY through the :class:`~parsec_tpu.device.tpu.TpuDevice`
+    manager (wave batching, lanes, LRU residency intact): the native
+    worker's trampoline only *enqueues* the task (chore returns ASYNC)
+    and the device manager's completion callback signals
+    ``pz_task_done`` — dependency release never re-enters the
+    interpreter.  Classes without an accelerator BODY fall back to their
+    CPU body through the Data staging discipline (mixed DAGs stay
+    coherent across host/device copies).  Pass ``device=`` to reuse one
+    device instance (and its jit cache) across executors.
     """
 
-    def __init__(self, tp: PTGTaskpool, *, graph: Optional[TaskGraph] = None):
+    def __init__(self, tp: PTGTaskpool, *, graph: Optional[TaskGraph] = None,
+                 native_device: bool = False, device=None):
         from .. import native
 
         if not native.available():
@@ -61,10 +135,37 @@ class NativeExecutor:
                 f"native core unavailable: {native.build_error()}")
         self._native = native
         self.taskpool = tp
+        self.native_device = bool(native_device)
+        self.device = device
+        self._pool_shim: Optional[_NativePoolShim] = None
+        if self.native_device:
+            if device is None:
+                self.device = self._make_device()
+            self._pool_shim = _NativePoolShim(self, f"native:{tp.ptg.name}")
         self.graph = graph if graph is not None else capture(tp, ranks=[0])
         self._new_tiles: Dict[Tuple, np.ndarray] = {}
-        self._bodies: List[Callable[[], None]] = []
+        self._new_data: Dict[Tuple, Any] = {}
+        #: tid -> the object PINS observers see for that task (device
+        #: tasks: the Task itself; CPU bodies: a _TaskInfo) — the static
+        #: dep-edge emitter walks this
+        self._trace_objs: Dict[Tuple, Any] = {}
+        self._bodies: List[Callable[[], Any]] = []
         self._build()
+
+    @staticmethod
+    def _make_device():
+        """One TpuDevice bound to a minimal single-rank context shim (the
+        native engine replaces the dynamic Context; the device module
+        only reads ``rank``/``nranks`` from it)."""
+        from ..device.tpu import TpuDevice
+
+        if not TpuDevice.available():
+            raise RuntimeError(
+                "native_device=True requires a JAX device (none available)")
+        shim = types.SimpleNamespace(rank=0, nranks=1, devices=[])
+        dev = TpuDevice(shim, index=1)
+        dev.attach()
+        return dev
 
     # -- tile resolution (same rules as ptg_to_dtd / xla_lower) ----------
     def _payload(self, srckey: Tuple) -> np.ndarray:
@@ -107,6 +208,13 @@ class NativeExecutor:
             index[tid] = ng.add_task(priority=node.priority,
                                      user_tag=len(self._bodies))
             self._bodies.append(self._make_body(tid))
+            if self.native_device:
+                # the completion callback needs the native id the task
+                # must signal; assigned here because _make_body built the
+                # task before the edge pass ran
+                obj = self._trace_objs.get(tid)
+                if isinstance(obj, _NativeDeviceTask):
+                    obj.native_id = index[tid]
         for tid in order:
             me = index[tid]
             for (_f, succ, _sf) in g.nodes[tid].out_edges:
@@ -119,7 +227,231 @@ class NativeExecutor:
             ng.commit(index[tid])
         ng.seal()
 
-    def _make_body(self, tid: Tuple) -> Callable[[], None]:
+    def _make_body(self, tid: Tuple) -> Callable[[], Any]:
+        """Body dispatcher: numpy in-place (default), device enqueue
+        (native_device + accelerator BODY), or Data-staged CPU fallback
+        (native_device, CPU-only class in a mixed DAG)."""
+        if self.native_device:
+            pc = self.taskpool.ptg.classes[tid[0]]
+            if any(dt != DEV_CPU for dt in pc.bodies):
+                return self._make_device_dispatch(tid)
+            return self._make_cpu_data_body(tid)
+        return self._make_numpy_body(tid)
+
+    # -- native device dispatch ------------------------------------------
+    def _flow_data(self, tid: Tuple, pc) -> List[Tuple[str, Any, Any]]:
+        """(flow name, Data-or-None, mode) per non-CTL flow, resolving
+        each flow's chain to its backing :class:`Data` (home collection
+        tile, or a synthesized NEW tile shared along the chain)."""
+        node = self.graph.nodes[tid]
+        out: List[Tuple[str, Any, Any]] = []
+        for f in pc.flows:
+            if f.mode == CTL:
+                continue
+            src = node.flow_sources.get(f.name)
+            if src is None and not (f.mode & AccessMode.OUT):
+                out.append((f.name, None, f.mode))
+                continue
+            out.append((f.name, self._data_for(source_tile(
+                self.graph, tid, f.name)), f.mode))
+        return out
+
+    def _data_for(self, srckey: Tuple):
+        """Data object behind a resolved flow chain (the device-path
+        sibling of :meth:`_payload`)."""
+        from ..data.data import data_create
+
+        if srckey[0] == "remote":
+            raise RuntimeError(
+                f"flow source {srckey[1]}/{srckey[2]} is on another rank; "
+                "use NativeDistExecutor for rank-filtered captures")
+        if srckey[0] == "data":
+            _, cname, key = srckey
+            return self.taskpool.constants[cname].data_of(*key)
+        d = self._new_data.get(srckey)
+        if d is None:
+            _, (pc_name, _locs), fname = srckey
+            shape, dtype = self.taskpool.new_tile_spec(pc_name, fname)
+            d = self._new_data[srckey] = data_create(
+                ("native_new",) + tuple(srckey[1:]),
+                payload=np.zeros(shape, dtype))
+        return d
+
+    def _scalars_of(self, pc, locs) -> Dict[str, Any]:
+        consts = self.taskpool.constants
+        scalars = {n: consts[n] for n in pc.body_globals}
+        scalars.update(zip(pc.param_names, locs))
+        if pc.def_names:
+            env = pc.env_of(locs, consts)
+            for n in pc.def_names:
+                scalars[n] = env[n]
+        return scalars
+
+    def _write_back_plan(self, tid: Tuple) -> List[Tuple[Any, str, Tuple]]:
+        """Cross-tile write-backs (flow chain source != home tile) that
+        the completion callback must land; in the common threading case
+        (dpotrf-style flows living in their home tiles) this is empty."""
+        node = self.graph.nodes[tid]
+        plan = []
+        for (fname, cname2, key) in node.write_backs:
+            src = source_tile(self.graph, tid, fname)
+            if src != ("data", cname2, tuple(key)):
+                plan.append((self._data_for(src), cname2, tuple(key)))
+        return plan
+
+    def _device_chore(self, pc) -> Chore:
+        """One Chore per class carrying the wrapped accelerator body
+        (jit-cache identity preserved via ``_jit_key``)."""
+        cache = self.__dict__.setdefault("_chore_cache", {})
+        chore = cache.get(pc.name)
+        if chore is None:
+            dev_type, fn = next(
+                (dt, f) for dt, f in pc.bodies.items() if dt != DEV_CPU)
+            chore = Chore(dev_type, hook=lambda es, task: HookReturn.ASYNC)
+            chore.body_fn = _wrap_device_body(pc, fn)
+            cache[pc.name] = chore
+        return chore
+
+    def _device_tclass(self, pc) -> TaskClass:
+        """Bare per-class vtable for device tasks: every slot the
+        completion path consults (release_deps, prepare_output, ...) is
+        None — successor release belongs to the native engine."""
+        cache = self.__dict__.setdefault("_tclass_cache", {})
+        tc = cache.get(pc.name)
+        if tc is None:
+            tc = cache[pc.name] = TaskClass(pc.name)
+        return tc
+
+    def _make_device_dispatch(self, tid: Tuple) -> Callable[[], Any]:
+        """Enqueue-only trampoline body: hand the prebuilt Task to the
+        device manager and return ASYNC.  Everything per-task beyond this
+        enqueue and the completion callback (which signals
+        ``pz_task_done``) runs either natively or inside the device
+        manager — never per-task interpreter bookkeeping."""
+        tp = self.taskpool
+        cname, locs = tid
+        pc = tp.ptg.classes[cname]
+        node = self.graph.nodes[tid]
+
+        task = _NativeDeviceTask(self._pool_shim, self._device_tclass(pc),
+                                 locs, node.priority)
+        task.selected_chore = self._device_chore(pc)
+        task.selected_device = self.device
+        # body_args in prepare_input layout: flows by declaration order
+        # (CTL placeholders keep f.index alignment), then values in the
+        # POSITIONAL contract order params, defs, body_globals — the
+        # order _wrap_device_body zips its names against (ptg.py; the
+        # dynamic path's prepare_input emits the same order)
+        specs: List[Tuple[str, Any, Any]] = []
+        flow_iter = iter(self._flow_data(tid, pc))
+        for f in pc.flows:
+            if f.mode == CTL:
+                specs.append(("ctl", None, CTL))
+            else:
+                _, data, mode = next(flow_iter)
+                specs.append(("data", data, mode))
+        scalars = self._scalars_of(pc, locs)
+        for name in pc.param_names + pc.def_names + pc.body_globals:
+            specs.append(("value", scalars[name], AccessMode.VALUE))
+        task.body_args = specs
+
+        wbs = self._write_back_plan(tid)
+        ng = self._ng
+
+        def on_complete(t: Task) -> None:
+            # the ONLY per-task Python on the completion side: land rare
+            # cross-tile write-backs, then signal the native release
+            if wbs:
+                from ..data.data import land_into_home
+
+                for (src_data, cname2, key) in wbs:
+                    home = self.taskpool.constants[cname2].data_of(*key)
+                    newest = src_data.newest_copy()
+                    land_into_home(home, newest.payload)
+            ng.task_done(t.native_id)
+
+        task.on_complete = on_complete
+        self._trace_objs[tid] = task
+        dev = self.device
+        shim = self._pool_shim
+
+        def body():
+            if shim.failed:
+                raise RuntimeError(
+                    f"native device pool failed: {shim.fail_reason}")
+            dev.kernel_scheduler(None, task)
+            return True  # ASYNC: pz_task_done releases the successors
+
+        return body
+
+    def _make_cpu_data_body(self, tid: Tuple) -> Callable[[], Any]:
+        """CPU-only class in a native_device DAG: run its CPU body through
+        the Data staging discipline (stage_to_cpu + version bumps) so
+        host and device copies stay coherent across the mixed graph."""
+        from .dtd import stage_to_cpu
+
+        tp = self.taskpool
+        cname, locs = tid
+        pc = tp.ptg.classes[cname]
+        fn = pc.bodies.get(DEV_CPU)
+        if fn is None:
+            raise ValueError(f"native_exec: class {cname} has no body")
+        flow_specs = self._flow_data(tid, pc)
+        scalars = self._scalars_of(pc, locs)
+        wbs = self._write_back_plan(tid)
+        info = _TaskInfo(cname, locs)
+        self._trace_objs[tid] = info
+
+        def body():
+            pins.fire(pins.EXEC_BEGIN, None, info)
+            kw: Dict[str, Any] = dict(scalars)
+            writable = []
+            for fname, data, mode in flow_specs:
+                if data is None:
+                    kw[fname] = None
+                    continue
+                arr = stage_to_cpu(data)
+                data.transfer_ownership(0, mode & AccessMode.INOUT)
+                kw[fname] = arr
+                if mode & AccessMode.OUT:
+                    writable.append(data)
+            result = fn(**kw)
+            if result is not None and not isinstance(result, HookReturn):
+                outs = (result if isinstance(result, (tuple, list))
+                        else (result,))
+                for data, new in zip(writable, outs):
+                    data.get_copy(0).payload = np.asarray(new)
+            for data in writable:
+                data.version_bump(0)
+            pins.fire(pins.EXEC_END, None, info)
+            pins.fire(pins.COMPLETE_EXEC_BEGIN, None, info)
+            if wbs:
+                from ..data.data import land_into_home
+
+                for (src_data, cname2, key) in wbs:
+                    home = self.taskpool.constants[cname2].data_of(*key)
+                    land_into_home(home, src_data.newest_copy().payload)
+            pins.fire(pins.COMPLETE_EXEC_END, None, info)
+            return False  # synchronous: the worker completes it inline
+
+        return body
+
+    def _emit_trace_edges(self) -> None:
+        """Bulk dep_edge emission for trace observers: the native path
+        never runs per-task release_deps in Python, so the captured DAG's
+        edges are published in ONE pre-run pass through the
+        RELEASE_DEPS_END site (payload shape matches the dynamic
+        runtime's) — profiling.critpath gets its predecessor map without
+        any hot-loop instrumentation."""
+        for tid, node in self.graph.nodes.items():
+            if not node.out_edges:
+                continue
+            succs = [self._trace_objs[s] for (_f, s, _sf) in node.out_edges]
+            pins.fire(pins.RELEASE_DEPS_END, None,
+                      (self._trace_objs[tid], succs))
+
+    # -- default numpy path ----------------------------------------------
+    def _make_numpy_body(self, tid: Tuple) -> Callable[[], None]:
         tp = self.taskpool
         g = self.graph
         consts = tp.constants
@@ -167,6 +499,7 @@ class NativeExecutor:
             write_backs.append((src if src != home else None, cname2, tuple(key)))
 
         info = _TaskInfo(cname, locs)
+        self._trace_objs[tid] = info
 
         def body() -> None:
             # PINS sites fire with es=None ("external" stream): the native
@@ -199,12 +532,29 @@ class NativeExecutor:
         locality domains and the native steal path prefers same-VP
         victims (reference lfq hierarchy)."""
         bodies = self._bodies
-
-        def trampoline(_task_id: int, user_tag: int) -> None:
-            bodies[user_tag]()
-
         self._apply_vpmap(nthreads)
-        n = self._ng.run(trampoline, nthreads=nthreads)
+        if pins.active(pins.RELEASE_DEPS_END):
+            self._emit_trace_edges()
+        if not self.native_device:
+            def trampoline(_task_id: int, user_tag: int) -> None:
+                bodies[user_tag]()
+
+            n = self._ng.run(trampoline, nthreads=nthreads)
+        else:
+            def atrampoline(_task_id: int, user_tag: int):
+                return bodies[user_tag]()
+
+            try:
+                n = self._ng.run_async(atrampoline, nthreads=nthreads)
+            except RuntimeError:
+                if self._pool_shim is not None and self._pool_shim.failed:
+                    raise RuntimeError(
+                        "native device run failed: "
+                        f"{self._pool_shim.fail_reason}") from None
+                raise
+            if self._pool_shim is not None and self._pool_shim.failed:
+                raise RuntimeError(
+                    f"native device run failed: {self._pool_shim.fail_reason}")
         if n != len(bodies):
             raise RuntimeError(
                 f"native engine retired {n}/{len(bodies)} tasks")
@@ -243,6 +593,13 @@ class NativeExecutor:
         every iteration.  Shape mismatches fail loudly — silently
         re-running the old DAG over a larger problem would factor a
         corner and report success."""
+        if self.native_device:
+            # device tasks bind Data objects and completion flags at build
+            # time; rewinding them safely would need a re-resolution pass.
+            # Build a fresh executor and pass device= to keep the jit cache.
+            raise NotImplementedError(
+                "rebind is not supported with native_device=True; build a "
+                "fresh NativeExecutor(tp, native_device=True, device=dev)")
         self._check_same_shape(tp)
         self.taskpool = tp
         self._new_tiles.clear()
@@ -280,6 +637,21 @@ class NativeExecutor:
         if ng is not None:
             ng.close()
             self._ng = None
+        dev = getattr(self, "device", None)
+        if dev is not None:
+            # flush dirty device tiles home so host-side readers (e.g.
+            # TiledMatrix.to_array) see final data; keep the device alive —
+            # the caller may be sharing it (and its jit cache) across
+            # executors.  A failed flush must be LOUD: swallowing it would
+            # hand the caller pre-run host tiles with rc 0 (if another
+            # exception is already unwinding, Python chains this one)
+            from ..utils import debug
+
+            try:
+                dev.detach()
+            except Exception as e:
+                debug.error("device detach (final write-back) failed: %s", e)
+                raise
 
     def __del__(self):  # pragma: no cover
         try:
@@ -288,9 +660,13 @@ class NativeExecutor:
             pass
 
 
-def run_native(tp: PTGTaskpool, *, nthreads: int = 4) -> int:
-    """One-shot: capture + native execution of ``tp``."""
-    ex = NativeExecutor(tp)
+def run_native(tp: PTGTaskpool, *, nthreads: int = 4,
+               native_device: bool = False, device=None) -> int:
+    """One-shot: capture + native execution of ``tp``.  With
+    ``native_device=True`` accelerator BODYs dispatch through the
+    TpuDevice manager from the native hot loop (ASYNC chores +
+    ``pz_task_done`` completion — see :class:`NativeExecutor`)."""
+    ex = NativeExecutor(tp, native_device=native_device, device=device)
     try:
         return ex.run(nthreads=nthreads)
     finally:

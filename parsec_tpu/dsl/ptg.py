@@ -751,6 +751,28 @@ class PTGTaskpool(Taskpool):
         self.auto_count = False
         self._counted = False
 
+    def capture(self, ranks: Optional[Sequence[int]] = None):
+        """Materialize this taskpool's full DAG (see
+        :func:`parsec_tpu.dsl.graph.capture`): the entry point of every
+        whole-graph consumer — XLA lowering, the native executor (CPU
+        chores or ``native_device=True`` dispatch), ptg→dtd replay."""
+        from .graph import capture as _capture
+
+        return _capture(self, ranks)
+
+    def run_native(self, *, nthreads: int = 4, native_device: bool = False,
+                   device=None) -> int:
+        """Execute this (unstarted) taskpool on the native C++ engine —
+        dependency counting, scheduling and termination never enter the
+        interpreter.  ``native_device=True`` additionally dispatches
+        accelerator BODYs through the TPU device manager as ASYNC chores
+        whose completions release successors natively (``pz_task_done``);
+        see :class:`parsec_tpu.dsl.native_exec.NativeExecutor`."""
+        from .native_exec import run_native as _run_native
+
+        return _run_native(self, nthreads=nthreads,
+                           native_device=native_device, device=device)
+
     def _make_dep_tracker(self):
         """Pick the dependency-storage backend (reference: per-class
         ``-M`` choice between dynamic hash table and dense index-array,

@@ -24,7 +24,7 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Callable, List, Optional, Sequence
+from typing import Any, Callable, List, Optional, Sequence
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(os.path.dirname(_HERE))
@@ -37,6 +37,28 @@ _lib_lock = threading.Lock()
 _build_error: Optional[str] = None
 
 _SOURCES = ["zone.cpp", "graph.cpp", "trace.cpp"]
+
+#: every C entry point the bindings below require.  Checked explicitly at
+#: load so a stale ``native/build/libparsec_core.so`` (e.g. sources updated
+#: but the rebuild failed or was skipped) produces ONE readable error via
+#: :func:`build_error` instead of a ctypes ``AttributeError`` deep inside a
+#: consumer.  ``missing_symbols()`` is the CI smoke hook over this list.
+REQUIRED_SYMBOLS = [
+    # zone allocator
+    "pz_zone_new", "pz_zone_destroy", "pz_zone_alloc", "pz_zone_release",
+    "pz_zone_used", "pz_zone_capacity", "pz_zone_largest_free",
+    "pz_zone_num_live",
+    # graph engine
+    "pz_graph_new", "pz_graph_destroy", "pz_graph_add_task",
+    "pz_graph_add_dep", "pz_graph_task_commit", "pz_graph_seal",
+    "pz_graph_run", "pz_graph_run_async", "pz_task_done", "pz_graph_fail",
+    "pz_graph_executed", "pz_graph_set_policy", "pz_graph_steals",
+    "pz_graph_steals_remote", "pz_graph_set_vpmap", "pz_graph_reset",
+    "pz_graph_run_noop", "pz_graph_order",
+    # binary tracer
+    "pt_tracer_new", "pt_tracer_destroy", "pt_stream_new", "pt_stream_id",
+    "pt_log", "pt_total_events", "pt_dump",
+]
 
 
 def _newest_mtime(paths: Sequence[str]) -> float:
@@ -85,6 +107,14 @@ def _load():
         if path is None:
             return None
         lib = ctypes.CDLL(path)
+        missing = [s for s in REQUIRED_SYMBOLS if not hasattr(lib, s)]
+        if missing:
+            global _build_error
+            _build_error = (
+                f"stale native library at {path}: missing symbol(s) "
+                f"{', '.join(missing)} — delete native/build/ (or touch "
+                "native/src/*.cpp) to force a rebuild")
+            return None
         # zone allocator
         lib.pz_zone_new.restype = ctypes.c_void_p
         lib.pz_zone_new.argtypes = [ctypes.c_size_t]
@@ -113,6 +143,12 @@ def _load():
         lib.pz_graph_run.restype = ctypes.c_int64
         lib.pz_graph_run.argtypes = [ctypes.c_void_p, BODY_FN, ctypes.c_void_p,
                                      ctypes.c_int32]
+        lib.pz_graph_run_async.restype = ctypes.c_int64
+        lib.pz_graph_run_async.argtypes = [ctypes.c_void_p, ASYNC_BODY_FN,
+                                           ctypes.c_void_p, ctypes.c_int32]
+        lib.pz_task_done.restype = ctypes.c_int
+        lib.pz_task_done.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pz_graph_fail.argtypes = [ctypes.c_void_p]
         lib.pz_graph_executed.restype = ctypes.c_int64
         lib.pz_graph_executed.argtypes = [ctypes.c_void_p]
         lib.pz_graph_set_policy.argtypes = [ctypes.c_void_p, ctypes.c_int32]
@@ -148,6 +184,20 @@ def _load():
 
 
 BODY_FN = ctypes.CFUNCTYPE(None, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p)
+#: async-capable body: returns 0 = completed synchronously, nonzero =
+#: ASYNC (completion arrives later via ``NativeGraph.task_done``)
+ASYNC_BODY_FN = ctypes.CFUNCTYPE(
+    ctypes.c_int32, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p)
+
+
+def missing_symbols() -> List[str]:
+    """Symbols from :data:`REQUIRED_SYMBOLS` absent from the built
+    library (empty when healthy).  The build smoke test asserts this is
+    empty so a stale ``native/build`` fails CI with a readable message."""
+    lib = _load()
+    if lib is None:
+        return list(REQUIRED_SYMBOLS)
+    return [s for s in REQUIRED_SYMBOLS if not hasattr(lib, s)]
 
 
 def available() -> bool:
@@ -308,6 +358,66 @@ class NativeGraph:
             raise RuntimeError("graph did not quiesce (cycle or uncommitted task)")
         return n
 
+    def run_async(self, body: Callable[[int, int], Any],
+                  nthreads: int = 2) -> int:
+        """Execute with an ASYNC-capable body (the reference's
+        PARSEC_HOOK_RETURN_ASYNC protocol): ``body(task_id, user_tag)``
+        returns falsy when the task completed synchronously, truthy when
+        a device manager took ownership — its completion must then be
+        signalled via :meth:`task_done`, which runs successor release
+        natively.  Blocks until every task (async included) completed.
+        A raising body aborts the run (:meth:`fail`) so completions that
+        will never arrive cannot hang the workers."""
+        errors: List[BaseException] = []
+
+        @ASYNC_BODY_FN
+        def trampoline(task_id, user_tag, _ctx):
+            try:
+                return 1 if body(task_id, user_tag) else 0
+            except BaseException as e:  # noqa: BLE001 - relayed to caller
+                errors.append(e)
+                self._lib.pz_graph_fail(self._g)
+                # report ASYNC, not done: an enqueue body may raise AFTER
+                # its task already completed through task_done (an inline
+                # manager drain completes tasks before returning) — a 0
+                # here would complete() it a second time and double-release
+                # successors.  The fail() above aborts the run either way.
+                return 1
+
+        self._keepalive.append(trampoline)
+        n = self._lib.pz_graph_run_async(self._g, trampoline, None, nthreads)
+        if errors:
+            raise errors[0]
+        if n < 0:
+            raise RuntimeError(
+                "graph did not quiesce (cycle, uncommitted task, or a "
+                "failed run with async completions outstanding)")
+        return n
+
+    def task_done(self, task_id: int) -> bool:
+        """Signal an ASYNC task's completion: dependency release,
+        ready-queue pushes and quiescence accounting all run natively
+        (``pz_task_done``).  Callable from any thread.  Returns False if
+        the task had already completed, or if the graph was already
+        closed (a straggler callback racing shutdown — harmless either
+        way, never a NULL handle into C); raises on an unknown id."""
+        g = self._g  # snapshot: close() may null it under our feet
+        if not g:
+            return False
+        rc = self._lib.pz_task_done(g, task_id)
+        if rc == -1:
+            raise ValueError(f"task_done: unknown task id {task_id}")
+        return rc == 0
+
+    def fail(self) -> None:
+        """Abort a live run: workers drain their current body and exit;
+        ``run``/``run_async`` then reports non-quiescence.  Use when an
+        ASYNC completion can no longer arrive (failed device pool).
+        No-op on a closed graph."""
+        g = self._g
+        if g:
+            self._lib.pz_graph_fail(g)
+
     def order(self) -> List[int]:
         """Priority-greedy topological order of a build-mode graph."""
         buf = (ctypes.c_int64 * max(self._n, 1))()
@@ -321,13 +431,25 @@ class NativeGraph:
         return self._lib.pz_graph_executed(self._g)
 
     def close(self) -> None:
-        if getattr(self, "_g", None):
-            self._lib.pz_graph_destroy(self._g)
+        """Detach: further run/task_done/fail calls no-op or raise.  The
+        native graph is destroyed only when this object is garbage-
+        collected (same discipline as :meth:`NativeTracer.close`): a
+        straggler completion thread racing close() necessarily still
+        holds a reference via its bound ``task_done`` callback, so its
+        handle snapshot can never touch freed memory."""
+        g = getattr(self, "_g", None)
+        if g:
             self._g = None
+            self._closed_handle = g
 
     def __del__(self):  # pragma: no cover
         try:
-            self.close()
+            g = getattr(self, "_g", None) or getattr(
+                self, "_closed_handle", None)
+            if g:
+                self._g = None
+                self._closed_handle = None
+                self._lib.pz_graph_destroy(g)
         except Exception:
             pass
 

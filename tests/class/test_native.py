@@ -238,3 +238,152 @@ def test_graph_large_order_fast():
     assert pos[ids[("p", 0)]] < pos[ids[("t", 0, 1)]] < pos[ids[("g", 0, 1, 1)]]
     assert dt < 2.0, f"native order too slow: {dt:.3f}s for {len(ids)} tasks"
     g.close()
+
+
+# -- ASYNC chore protocol (pz_graph_run_async / pz_task_done) ----------------
+
+def test_graph_async_out_of_order_completion():
+    """ASYNC chores complete OUT OF ORDER from background threads via
+    task_done; successor release order must still respect the DAG, and
+    shutdown is clean with straggler callbacks still in flight (the
+    device-manager completion shape behind native device dispatch)."""
+    import time
+
+    g = native.NativeGraph()
+    # diamond: a -> (b, c) -> d ; b and c are ASYNC, completed by
+    # background threads in REVERSE submission order
+    a, b, c, d = (g.add_task() for _ in range(4))
+    g.add_dep(a, b)
+    g.add_dep(a, c)
+    g.add_dep(b, d)
+    g.add_dep(c, d)
+    for t in (a, b, c, d):
+        g.commit(t)
+    g.seal()
+
+    started, done_order = [], []
+    lock = threading.Lock()
+    threads = []
+
+    def complete_later(tid, delay):
+        time.sleep(delay)
+        with lock:
+            done_order.append(tid)
+        assert g.task_done(tid) is True
+
+    def body(tid, tag):
+        with lock:
+            started.append(tid)
+        if tid in (b, c):
+            # b (submitted first) completes LAST: out-of-order wrt submit
+            delay = 0.08 if tid == b else 0.02
+            th = threading.Thread(target=complete_later, args=(tid, delay))
+            threads.append(th)
+            th.start()
+            return True  # ASYNC
+        return False
+
+    n = g.run_async(body, nthreads=2)
+    assert n == 4
+    # d ran only after BOTH async completions; c's completion preceded b's
+    assert started[0] == a and started[-1] == d
+    assert done_order == [c, b]
+    assert set(started) == {a, b, c, d}
+    for th in threads:
+        th.join(timeout=5)
+    # straggler callback after shutdown: harmless no-op, not a crash
+    assert g.task_done(b) is False
+    with pytest.raises(ValueError):
+        g.task_done(999)
+    g.close()
+
+
+def test_graph_async_release_order_chain():
+    """A chain behind an ASYNC head must not start until task_done."""
+    import time
+
+    g = native.NativeGraph()
+    head = g.add_task()
+    succ = g.add_task()
+    g.add_dep(head, succ)
+    g.commit(head)
+    g.commit(succ)
+    g.seal()
+    events = []
+
+    def body(tid, tag):
+        events.append(("run", tid, time.monotonic()))
+        if tid == head:
+            def later():
+                time.sleep(0.05)
+                events.append(("done", head, time.monotonic()))
+                g.task_done(head)
+            threading.Thread(target=later).start()
+            return True
+        return False
+
+    assert g.run_async(body, nthreads=2) == 2
+    kinds = [(k, t) for (k, t, _ts) in events]
+    assert kinds == [("run", head), ("done", head), ("run", succ)]
+    g.close()
+
+
+def test_graph_async_body_error_aborts_run():
+    """A raising async-path body must abort the run loudly, never hang
+    waiting for a completion that cannot arrive."""
+    g = native.NativeGraph()
+    a = g.add_task()
+    b = g.add_task()
+    g.add_dep(a, b)
+    g.commit(a)
+    g.commit(b)
+    g.seal()
+
+    def body(tid, tag):
+        raise RuntimeError("enqueue exploded")
+
+    with pytest.raises(RuntimeError, match="enqueue exploded"):
+        g.run_async(body, nthreads=2)
+    g.close()
+
+
+def test_graph_fail_unblocks_async_run():
+    """fail() releases workers parked on an ASYNC task whose completion
+    never arrives (the failed-device-pool shape)."""
+    import time
+
+    g = native.NativeGraph()
+    a = g.add_task()
+    g.commit(a)
+    g.seal()
+
+    def body(tid, tag):
+        threading.Thread(target=lambda: (time.sleep(0.05), g.fail())).start()
+        return True  # ASYNC, and nobody will ever complete it
+
+    with pytest.raises(RuntimeError, match="did not quiesce"):
+        g.run_async(body, nthreads=2)
+    g.close()
+
+
+def test_native_required_symbols_present():
+    """Build smoke (CI): every C entry point the bindings need exists in
+    the built library — a stale native/build fails HERE with a readable
+    message instead of a ctypes AttributeError deep in a consumer."""
+    assert native.missing_symbols() == []
+    for sym in ("pz_task_done", "pz_graph_run_async", "pz_graph_fail"):
+        assert sym in native.REQUIRED_SYMBOLS
+
+
+def test_graph_task_done_after_close_is_noop():
+    """The shutdown promise holds even past close(): a straggler
+    task_done/fail on a closed graph is a harmless no-op, never a NULL
+    handle into the C layer."""
+    g = native.NativeGraph()
+    a = g.add_task()
+    g.commit(a)
+    g.seal()
+    g.run_async(lambda tid, tag: False, nthreads=1)
+    g.close()
+    assert g.task_done(a) is False
+    g.fail()  # no-op on a closed graph, not a crash

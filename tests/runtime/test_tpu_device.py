@@ -393,3 +393,57 @@ def test_wave_batching_disabled_by_param():
             ctx.fini()
     finally:
         mca_param.params.unset("device", "tpu_wave_batch")
+
+
+def test_wave_staging_is_per_chunk(ctx):
+    """ADVICE round-5 #1 pin: _submit_wave stages each pow2 chunk's
+    inputs immediately before THAT chunk's dispatch — never the whole
+    wave up front — so peak HBM holds one chunk's inputs, not the
+    wave's.  Observed through the stage hook + the native-path EXEC
+    pins: a 6-task wave (chunks 4+2) must interleave stage(4) →
+    dispatch(4) → stage(2) → dispatch(2)."""
+    from parsec_tpu.core.task import Chore, TaskClass
+    from parsec_tpu.dsl.native_exec import _NativeDeviceTask
+    from parsec_tpu.profiling import pins
+    from types import SimpleNamespace
+
+    dev = tpu_dev(ctx)
+    events = []
+
+    orig_stage = dev._stage_task_args
+
+    def recording_stage(task, body):
+        events.append(("stage", id(task)))
+        return orig_stage(task, body)
+
+    dev._stage_task_args = recording_stage
+
+    def on_exec(es, task):
+        events.append(("dispatch", task.prof.get("wave")))
+
+    pins.subscribe(pins.EXEC_BEGIN, on_exec)
+
+    pool = SimpleNamespace(failed=False, task_done=lambda t=None: None,
+                           context=None)
+    tclass = TaskClass("wavetest")
+    chore = Chore(DEV_TPU, hook=lambda es, t: None)
+    chore.body_fn = lambda x: x + 1.0
+    tasks = []
+    for i in range(6):
+        t = _NativeDeviceTask(pool, tclass, (i,), 0)
+        t.selected_chore = chore
+        t.body_args = [("data", data_create(
+            ("wv", i), payload=np.ones((8, 8), np.float32)), INOUT)]
+        t.on_complete = lambda task: None
+        tasks.append(t)
+    try:
+        dev._submit_wave(tasks, None)
+    finally:
+        dev._stage_task_args = orig_stage
+        pins.unsubscribe(pins.EXEC_BEGIN, on_exec)
+
+    kinds = [k for (k, _v) in events]
+    # 6 = 4 + 2: four stages, four dispatches, two stages, two dispatches
+    assert kinds == (["stage"] * 4 + ["dispatch"] * 4
+                     + ["stage"] * 2 + ["dispatch"] * 2), kinds
+    assert [v for (k, v) in events if k == "dispatch"] == [4, 4, 4, 4, 2, 2]
