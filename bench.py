@@ -493,12 +493,90 @@ def _rest_of_main(N, NB, dtype, backend, on_accel, reps, rtt,
     if not _over_budget(0.87, "dynamic native stage"):
         _leg(fields, "dynamic_native", dynamic_native_leg)
 
+    # ---- STAGE 3c: comm wire protocol (round-7 tentpole) ---------------
+    # Two real TCP endpoints over loopback: eager-regime round-trip
+    # latency + chunked-rendezvous pull bandwidth, with bytes-on-wire
+    # recorded — the single-chip analogue of the MULTICHIP wire columns
+    # (the distributed legs live in __graft_entry__.dryrun_multichip).
+    if os.environ.get("BENCH_WIRE", "1") != "0":
+        _leg(fields, "comm_wire", lambda: comm_wire_leg(fields))
+
     # ---- STAGE 4: QR / LU through the runtime --------------------------
     if on_accel and os.environ.get("BENCH_QRLU", "1") != "0" \
             and not _over_budget(0.80, "qr/lu stage"):
         qrlu_stage(int(os.environ.get("BENCH_QRLU_N", "8192")),
                    int(os.environ.get("BENCH_QRLU_NB", "512")),
                    measure, fields)
+
+
+def comm_wire_leg(fields: dict) -> None:
+    import tempfile
+    import threading as _th
+
+    from parsec_tpu.comm.engine import TAG_USER_BASE
+    from parsec_tpu.comm.payload import as_bytes, wire_header
+    from parsec_tpu.comm.remote_dep import RemoteDepManager, _RdvPull
+    from parsec_tpu.comm.tcp import TCPComm
+
+    rdv = tempfile.mkdtemp(prefix="bench_wire_")
+    ces = [None, None]
+
+    def mk(r):
+        ces[r] = TCPComm(r, 2, rendezvous_dir=rdv)
+
+    ts = [_th.Thread(target=mk, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    try:
+        # eager-class round-trip: 1 KiB payload ping-pong, median of 64
+        pong = _th.Event()
+        ces[0].register_am(TAG_USER_BASE, lambda s, p: pong.set())
+        ces[1].register_am(TAG_USER_BASE,
+                           lambda s, p: ces[1].send_am(TAG_USER_BASE, 0, p))
+        msg = np.zeros(128)  # 1 KiB: below the eager limit
+        rtts = []
+        for _ in range(64):
+            pong.clear()
+            t0 = time.perf_counter()
+            ces[0].send_am(TAG_USER_BASE, 1, msg)
+            if not pong.wait(10):
+                raise RuntimeError("wire ping-pong timed out")
+            rtts.append(time.perf_counter() - t0)
+        rtts.sort()
+        fields["wire_eager_rtt_us"] = round(1e6 * rtts[len(rtts) // 2], 1)
+
+        # rendezvous bandwidth: a 32 MiB tile pulled through the real
+        # chunk-pipelined engine (pipeline_depth in-flight get_parts)
+        rd1 = RemoteDepManager(ces[1])
+        tile = np.random.default_rng(3).standard_normal(4 << 20)  # 32 MiB
+        ces[0].mem_register(("bw",), as_bytes(tile), uses=1)
+        got = _th.Event()
+        out = []
+
+        def done(arr):
+            out.append(arr)
+            got.set()
+
+        t0 = time.perf_counter()
+        _RdvPull(rd1, 0, {"handle": ("bw",), "hdr": wire_header(tile),
+                          "nbytes": tile.nbytes}, done)
+        if not got.wait(60):
+            raise RuntimeError("rendezvous pull timed out")
+        dt = time.perf_counter() - t0
+        if out[0] is None or float(out[0][0]) != float(tile[0]):
+            raise RuntimeError("rendezvous payload mismatch")
+        fields["wire_rdv_MBps"] = round(tile.nbytes / dt / 1e6, 1)
+        fields["wire_rdv_chunks"] = int(rd1.stats["rdv_chunks_req"])
+        fields["wire_bytes"] = int(ces[0].stats["am_bytes"]
+                                   + ces[1].stats["am_bytes"])
+    finally:
+        ts = [_th.Thread(target=ce.close) for ce in ces if ce is not None]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
 
 
 def panel_stage(n: int, nb: int, rtt: float, fields: dict) -> None:

@@ -20,10 +20,11 @@ shared memory except through ``put``/``get``.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Any, Callable, Dict, Optional, TYPE_CHECKING
 
-from ..utils import Component, debug
+from ..utils import Component, debug, mca_param
 
 if TYPE_CHECKING:  # pragma: no cover
     from ..core.context import Context
@@ -38,6 +39,13 @@ TAG_DTD = 5             # DTD tile-version transfers (shadow-task protocol)
 TAG_USER_BASE = 6
 MAX_AM_TAGS = 12
 
+#: wire-protocol defaults — single source of truth for the engine class
+#: attributes, ``_init_protocol``'s registrations, and the protocol
+#: layer's own (idempotent) re-registrations in ``remote_dep``
+EAGER_LIMIT_DEFAULT = 8192
+PIPELINE_DEPTH_DEFAULT = 4
+RDV_CHUNK_DEFAULT = 256 << 10
+
 
 class CommEngine(Component):
     """Backend vtable. One instance per rank."""
@@ -46,6 +54,57 @@ class CommEngine(Component):
 
     rank: int = 0
     nranks: int = 1
+
+    # -- wire-protocol tunables (reference: the eager/rendezvous split of
+    # remote_dep_mpi.c — parsec_param_short_limit / the pipelined GET
+    # depth of the put/get handshake).  Registered + VALIDATED at engine
+    # construction: a zero/negative depth would not error anywhere on its
+    # own, it would simply never issue a chunk request and hang the first
+    # large transfer — reject it here with a readable message instead.
+    eager_limit: int = EAGER_LIMIT_DEFAULT
+    pipeline_depth: int = PIPELINE_DEPTH_DEFAULT
+    rdv_chunk: int = RDV_CHUNK_DEFAULT
+    coalesce_enabled: bool = True
+    #: True when one-sided pull traffic rides AM frames (and is therefore
+    #: already inside ``stats["am_bytes"]``) — wire-byte accounting must
+    #: not add ``get_bytes`` on top for such engines (TCP's GET answers),
+    #: but must for table-served fabrics (inproc) where pulls bypass
+    #: frames entirely
+    pull_bytes_in_frames: bool = False
+
+    def _init_protocol(self) -> None:
+        """Register the comm-protocol MCA params (env-overridable as
+        ``PARSEC_MCA_runtime_comm_*``) and validate them.  Called by every
+        backend's constructor."""
+        self.eager_limit = int(mca_param.register(
+            "runtime", "comm_eager_limit", EAGER_LIMIT_DEFAULT,
+            help="payloads at or below this many bytes ship inline with "
+                 "the activation (eager regime, zero extra round trips); "
+                 "larger ones use the pipelined chunked rendezvous"))
+        self.pipeline_depth = int(mca_param.register(
+            "runtime", "comm_pipeline_depth", PIPELINE_DEPTH_DEFAULT,
+            help="in-flight chunk requests per rendezvous transfer"))
+        self.rdv_chunk = int(mca_param.register(
+            "runtime", "comm_rdv_chunk", RDV_CHUNK_DEFAULT,
+            help="rendezvous chunk size (bytes); each chunk is one "
+                 "get round-trip, pipeline_depth of them in flight"))
+        self.coalesce_enabled = bool(mca_param.register(
+            "runtime", "comm_coalesce", True,
+            help="coalesce all messages queued for one destination in "
+                 "one progress cycle into a single frame"))
+        if self.eager_limit < 0:
+            raise ValueError(
+                f"runtime_comm_eager_limit must be >= 0 (0 sends every "
+                f"payload through rendezvous), got {self.eager_limit}")
+        if self.pipeline_depth <= 0:
+            raise ValueError(
+                f"runtime_comm_pipeline_depth must be >= 1 (a transfer "
+                f"with no in-flight chunk requests would hang, not "
+                f"error), got {self.pipeline_depth}")
+        if self.rdv_chunk <= 0:
+            raise ValueError(
+                f"runtime_comm_rdv_chunk must be >= 1 byte, "
+                f"got {self.rdv_chunk}")
 
     # -- lifecycle ------------------------------------------------------
     def attach_context(self, context: "Context") -> None:
@@ -69,8 +128,25 @@ class CommEngine(Component):
         """cb(src_rank, payload) runs during ``progress``."""
         raise NotImplementedError
 
-    def send_am(self, tag: int, dst_rank: int, payload: Any) -> None:
+    def send_am(self, tag: int, dst_rank: int, payload: Any,
+                priority: int = 0) -> None:
+        """Queue an active message.  ``priority`` orders messages that
+        share one coalesced frame / drain cycle (higher leaves first —
+        critical-path tiles ahead of bulk updates); FIFO is preserved
+        among equal priorities, and ordering never crosses progress
+        cycles, so control handshakes queued in an earlier cycle are
+        never overtaken."""
         raise NotImplementedError
+
+    @contextlib.contextmanager
+    def coalesce(self):
+        """Coalescing window: messages sent inside nest into per-
+        destination queues and flush as ONE frame per destination when
+        the outermost window closes (the per-peer aggregation of the
+        reference comm thread, remote_dep_mpi.c:1066-1190).  Backends
+        with a dedicated comm thread already aggregate at drain time and
+        keep this a no-op; synchronous fabrics buffer."""
+        yield
 
     # -- piggyback channel (reference termdet.h:153-232: termination-
     # detection state rides APPLICATION messages; dedicated waves are the
@@ -146,6 +222,19 @@ class CommEngine(Component):
 
     def get(self, src_rank: int, handle: Any, on_done: Callable[[Any], None]) -> None:
         """Pull a registered remote buffer; on_done(buffer) fires locally."""
+        raise NotImplementedError
+
+    def get_part(self, src_rank: int, handle: Any, offset: int,
+                 length: int, on_done: Callable[[Any], None],
+                 fin: bool = False, priority: int = 0) -> None:
+        """Pull ``length`` bytes at byte ``offset`` of a registered remote
+        buffer (the pipelined rendezvous chunk fetch; reference: the
+        chunked wire_get of remote_dep_mpi.c's put/get handshake).
+        ``on_done(chunk)`` receives a byte-addressable array (or None on
+        a protocol error).  ``fin`` marks the LAST chunk this consumer
+        will request: use-counted registrations decrement exactly once
+        per consumer, on the fin request, so a chunked transfer counts
+        like one GET."""
         raise NotImplementedError
 
     # -- datatype serialization (reference CE pack/unpack slots,

@@ -50,3 +50,65 @@ def prefetch_to_host(arrs: Iterable) -> None:
 def to_wire(arr) -> np.ndarray:
     """One D2H (or zero-copy alias on the CPU backend) to wire form."""
     return np.asarray(arr)
+
+
+# -- header + raw-bytes framing (the zero-copy wire form) -----------------
+#
+# A contiguous ndarray crosses the wire as a tiny picklable HEADER (shape/
+# dtype/order) plus its raw bytes — the receiver reconstructs a view over
+# whatever buffer the bytes landed in (an arena slot, a preallocated
+# rendezvous buffer) without ever invoking pickle on the payload.  Pickle
+# stays as the fallback for everything else: non-contiguous views (the
+# datatype layer gathers those first), object dtypes, arbitrary objects.
+
+def raw_framable(arr) -> bool:
+    """True when ``arr`` can ship as header+raw-bytes: a contiguous,
+    non-object-dtype numpy ndarray (zero-size included — its raw form is
+    simply zero bytes)."""
+    return (isinstance(arr, np.ndarray)
+            and arr.dtype != object
+            and (arr.flags.c_contiguous or arr.flags.f_contiguous))
+
+
+def wire_header(arr: np.ndarray) -> dict:
+    """Self-describing header for a raw-framed array (dtype rides as the
+    portable ``str`` form; ``order`` records Fortran layout so column-
+    major tiles round-trip without a transpose copy)."""
+    return {
+        "shape": arr.shape,
+        "dtype": arr.dtype.str,
+        "order": "F" if (arr.ndim > 1 and arr.flags.f_contiguous
+                         and not arr.flags.c_contiguous) else "C",
+        "nbytes": arr.nbytes,
+    }
+
+
+def as_bytes(arr: np.ndarray) -> np.ndarray:
+    """Flat uint8 alias of a contiguous array's memory (no copy)."""
+    if arr.ndim > 1 and arr.flags.f_contiguous and not arr.flags.c_contiguous:
+        arr = arr.T  # the raw bytes ARE column-major; header says so
+    return arr.reshape(-1).view(np.uint8)
+
+
+def byte_slice(buf, offset: int, length: int) -> np.ndarray:
+    """Byte-range view of a registered buffer (rendezvous chunk serve).
+    Registered rendezvous buffers are flat uint8 views already; anything
+    else is reduced to its raw bytes first (contiguity enforced at
+    registration by the protocol layer)."""
+    if not (isinstance(buf, np.ndarray) and buf.dtype == np.uint8
+            and buf.ndim == 1):
+        buf = as_bytes(np.ascontiguousarray(buf))
+    return buf[offset:offset + length]
+
+
+def from_wire(header: dict, buf) -> np.ndarray:
+    """Rebuild the array as a VIEW over ``buf`` (any byte-addressable
+    buffer of at least ``header['nbytes']`` bytes — an arena slot, a
+    rendezvous buffer).  The result aliases ``buf``; buffer lifetime is
+    the caller's business (arena slots self-release via finalizers)."""
+    dt = np.dtype(header["dtype"])
+    flat = np.frombuffer(memoryview(buf)[:header["nbytes"]], dtype=dt)
+    shape = tuple(header["shape"])
+    if header.get("order") == "F":
+        return flat.reshape(shape[::-1]).T
+    return flat.reshape(shape)

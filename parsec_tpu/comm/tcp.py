@@ -61,6 +61,7 @@ import numpy as np
 from ..profiling import pins
 from ..utils import debug, mca_param, register_component
 from .engine import CommEngine, MAX_AM_TAGS
+from .payload import byte_slice
 
 # internal tag space (reference registers internal GET/PUT AM tags at init,
 # parsec_mpi_funnelled.c:583-592); user tags must stay below these.
@@ -175,6 +176,8 @@ class TCPComm(CommEngine):
 
     mca_name = "tcp"
     mca_priority = 20
+    #: GET answers are AM frames: their bytes already land in am_bytes
+    pull_bytes_in_frames = True
 
     def __init__(
         self,
@@ -204,9 +207,15 @@ class TCPComm(CommEngine):
         self._pending_gets: Dict[int, Callable[[Any], None]] = {}
         self._get_seq = 0
         self._get_lock = threading.Lock()
+        # wire-protocol tunables (eager/rendezvous/coalescing), registered
+        # and validated before anything can queue traffic
+        self._init_protocol()
         # MPSC command queue drained by the comm thread (reference
-        # dep_cmd_queue, remote_dep_mpi.c:513-520)
-        self._cmds: "queue.SimpleQueue[Tuple[int, int, Any]]" = queue.SimpleQueue()
+        # dep_cmd_queue, remote_dep_mpi.c:513-520); entries are
+        # (dst, tag, payload, priority) — the drain orders each peer's
+        # batch by priority (critical-path tiles leave first), FIFO among
+        # equals, never across drain cycles
+        self._cmds: "queue.SimpleQueue[Tuple[int, int, Any, int]]" = queue.SimpleQueue()
         self._wake_r, self._wake_w = socket.socketpair()
         self._wake_r.setblocking(False)
         self._wake_w.setblocking(False)  # a full wake pipe is skipped, not blocked on
@@ -234,8 +243,12 @@ class TCPComm(CommEngine):
         self._socks: Dict[int, socket.socket] = {}
         #: per-peer streaming frame parsers (recv_into arena slots)
         self._rx: Dict[int, _RecvState] = {}
-        #: receive arenas by power-of-two size class
-        self._rx_arenas: Dict[int, Any] = {}
+        # receive arenas by power-of-two size class (recv_into targets;
+        # backpressure is TCP's job, so the pool is uncapped — a None
+        # from allocate() would kill the comm thread mid-frame)
+        from ..data.arena import BytePool
+
+        self._rx_pool = BytePool(f"rx{rank}")
         self.max_frame = mca_param.register(
             "runtime", "comm_max_frame", 1 << 31,
             help="per-frame cap (bytes) on control blob / payload total; "
@@ -329,14 +342,15 @@ class TCPComm(CommEngine):
             for src, payload in parked:
                 self._dispatch(tag, src, payload)
 
-    def send_am(self, tag: int, dst_rank: int, payload: Any) -> None:
+    def send_am(self, tag: int, dst_rank: int, payload: Any,
+                priority: int = 0) -> None:
         self.stats[f"am_sent_{tag}"] += 1
         if dst_rank == self.rank:
             # self-sends short-circuit (reference delivers locally too)
             self._dispatch(tag, self.rank, payload)
             return
         self._termdet_note_sent(tag)
-        self._cmds.put((dst_rank, tag, payload))
+        self._cmds.put((dst_rank, tag, payload, priority))
         try:
             self._wake_w.send(b"\0")
         except (BlockingIOError, OSError):
@@ -359,11 +373,14 @@ class TCPComm(CommEngine):
             self._mem.pop(handle, None)
             self._mem_uses.pop(handle, None)
 
-    def _mem_take(self, handle: Any, default=None):
+    def _mem_take(self, handle: Any, default=None, consume: bool = True):
         """Read a registered buffer; use-counted registrations self-reclaim
-        after their declared number of GETs."""
+        after their declared number of GETs.  ``consume=False`` peeks
+        without touching the count (non-final rendezvous chunks)."""
         with self._mem_lock:
             buf = self._mem.get(handle, default)
+            if not consume:
+                return buf
             uses = self._mem_uses.get(handle)
             if uses is not None:
                 if uses <= 1:
@@ -386,14 +403,45 @@ class TCPComm(CommEngine):
             self._pending_gets[req] = on_done
         self.send_am(TAG_GET_REQ, src_rank, {"req": req, "handle": handle})
 
+    def get_part(self, src_rank: int, handle: Any, offset: int,
+                 length: int, on_done, fin: bool = False,
+                 priority: int = 0) -> None:
+        """Rendezvous chunk fetch: the AM-handshake emulation of a
+        one-sided partial read.  Only the ``fin`` request consumes a
+        use-counted registration (one decrement per consumer, however
+        many chunks it pulled); the answer echoes the request's priority
+        so critical-path chunks overtake bulk ones in the peer's drain."""
+        if src_rank == self.rank:
+            buf = self._mem_take(handle, consume=fin)
+            if buf is None:
+                raise KeyError(f"no registered memory {handle!r} locally")
+            on_done(byte_slice(buf, offset, length))
+            return
+        with self._get_lock:
+            self._get_seq += 1
+            req = self._get_seq
+            self._pending_gets[req] = on_done
+        self.send_am(TAG_GET_REQ, src_rank,
+                     {"req": req, "handle": handle, "off": offset,
+                      "len": length, "fin": fin, "prio": priority},
+                     priority=priority)
+
     def _on_get_req(self, src: int, msg: dict) -> None:
-        buf = self._mem_take(msg["handle"], _MISSING)
-        if buf is _MISSING:
+        part = "off" in msg
+        buf = self._mem_take(msg["handle"], _MISSING,
+                             consume=(not part) or msg.get("fin", False))
+        if buf is _MISSING or buf is None:
             debug.error("rank %d: GET for unknown handle %r", self.rank, msg["handle"])
             self.send_am(TAG_GET_ANS, src,
-                         {"req": msg["req"], "error": f"unknown handle {msg['handle']!r}"})
+                         {"req": msg["req"], "error": f"unknown handle {msg['handle']!r}"},
+                         priority=msg.get("prio", 0))
             return
-        self.send_am(TAG_GET_ANS, src, {"req": msg["req"], "data": buf})
+        if part:
+            # contiguous slice of the registered bytes: ships out-of-band
+            # as a zero-copy buffer (no intermediate copy on this side)
+            buf = byte_slice(buf, msg["off"], msg["len"])
+        self.send_am(TAG_GET_ANS, src, {"req": msg["req"], "data": buf},
+                     priority=msg.get("prio", 0))
 
     def _on_get_ans(self, src: int, msg: dict) -> None:
         with self._get_lock:
@@ -441,8 +489,11 @@ class TCPComm(CommEngine):
                 if n == self.nranks:
                     self._barrier_state.pop(("count", epoch))
                     for r in range(1, self.nranks):
+                        # control handshake: ahead of any data sharing
+                        # the drain cycle (peers are blocked on it)
                         self._cmds.put((r, TAG_BARRIER,
-                                        {"epoch": epoch, "phase": "release"}))
+                                        {"epoch": epoch, "phase": "release"},
+                                        1 << 30))
                     try:
                         self._wake_w.send(b"\0")
                     except (BlockingIOError, OSError):
@@ -482,7 +533,9 @@ class TCPComm(CommEngine):
                 fin_sent = True
                 fin_deadline = time.monotonic() + self.close_timeout
                 for r in list(self._socks):
-                    self._cmds.put((r, TAG_FIN, None))
+                    # lowest priority: a FIN must never be reordered
+                    # ahead of data it happens to share a frame with
+                    self._cmds.put((r, TAG_FIN, None, -(1 << 30)))
                 continue  # next iteration flushes the FINs
             if self._cmds.empty() and all(
                     r in self._peer_fin for r in self._socks):
@@ -500,17 +553,26 @@ class TCPComm(CommEngine):
 
     def _drain_cmds(self) -> int:
         """Drain the command queue, aggregating per peer into one frame
-        (reference per-peer rings, remote_dep_mpi.c:1095-1132)."""
-        batches: Dict[int, List[Tuple[int, Any]]] = collections.defaultdict(list)
+        (reference per-peer rings, remote_dep_mpi.c:1095-1132), PRIORITY-
+        ordered within the cycle: each peer's batch is stable-sorted by
+        descending priority (critical-path activations and their chunk
+        answers leave first, FIFO among equals), and peers themselves go
+        out highest-priority-first.  Ordering never crosses drain cycles,
+        so earlier-cycle control traffic is never overtaken."""
+        pending: Dict[int, List[Tuple[int, int, Any]]] = collections.defaultdict(list)
         n = 0
         while True:
             try:
-                dst, tag, payload = self._cmds.get_nowait()
+                dst, tag, payload, prio = self._cmds.get_nowait()
             except queue.Empty:
                 break
-            batches[dst].append((tag, payload))
+            pending[dst].append((prio, tag, payload))
             n += 1
-        for dst, whole in batches.items():
+        order = sorted(pending.items(),
+                       key=lambda kv: -max(p for p, _t, _p in kv[1]))
+        for dst, items in order:
+            items.sort(key=lambda it: -it[0])  # stable: FIFO among equals
+            whole = [(tag, payload) for _prio, tag, payload in items]
             for batch in self._frame_chunks(whole):
                 self._send_frame(dst, batch)
         return n
@@ -576,7 +638,8 @@ class TCPComm(CommEngine):
         if wire:
             pins.fire(pins.COMM_SEND_BEGIN, None,
                       {"rank": self.rank, "peer": dst,
-                       "bytes": frame_bytes, "qdepth": self._cmds.qsize()})
+                       "bytes": frame_bytes, "coalesced": len(batch),
+                       "qdepth": self._cmds.qsize()})
         try:
             # byte-tracked sends: sendall on a non-blocking socket can
             # transmit part of the frame before raising, with no way to
@@ -740,22 +803,16 @@ class TCPComm(CommEngine):
         st.reset()
         return delivered
 
+    @property
+    def _rx_arenas(self) -> Dict[int, Any]:
+        """Size-class view of the receive pool (diagnostics/tests)."""
+        return self._rx_pool._classes
+
     def _rx_alloc(self, nbytes: int):
         """Arena slot for an incoming payload: power-of-two size classes
         of raw bytes, recycled across frames (reference arena-backed
         receives)."""
-        from ..data.arena import Arena
-
-        k = max(9, int(nbytes - 1).bit_length()) if nbytes > 1 else 9
-        ar = self._rx_arenas.get(k)
-        if ar is None:
-            ar = self._rx_arenas[k] = Arena((1 << k,), np.uint8,
-                                            name=f"rx-{1 << k}")
-            # receives must always land (backpressure is TCP's job): the
-            # global arena_max_used cap would make allocate() return None
-            # and kill the comm thread mid-frame
-            ar.max_used = 0
-        return ar.allocate()
+        return self._rx_pool.allocate(nbytes)
 
     def _rx_deliver(self, st: _RecvState) -> int:
         """Frame complete: rebuild the batch with arrays aliasing the

@@ -71,3 +71,45 @@ class Arena:
                 "used": self.nb_used,
                 "created": self.nb_created,
             }
+
+
+class BytePool:
+    """Power-of-two size-classed arenas of raw bytes — the recycled
+    landing buffers for wire payloads (reference: arena-backed receives,
+    ``remote_dep_mpi.c:870-930``).  One :class:`Arena` of ``uint8`` per
+    size class; ``allocate(nbytes)`` returns a DataCopy whose payload has
+    at least ``nbytes`` bytes.  Classes are uncapped by ``arena_max_used``
+    (receives must always land — backpressure belongs to the transport,
+    and a None from ``allocate`` would kill a comm thread mid-frame)."""
+
+    MIN_CLASS = 9  # 512 B — below this, slack beats class explosion
+
+    def __init__(self, name: str = "bytes"):
+        self.name = name
+        self._classes: dict = {}
+        self._lock = threading.Lock()
+
+    def _arena_for(self, nbytes: int) -> Arena:
+        k = max(self.MIN_CLASS, int(nbytes - 1).bit_length()) \
+            if nbytes > 1 else self.MIN_CLASS
+        with self._lock:
+            ar = self._classes.get(k)
+            if ar is None:
+                ar = self._classes[k] = Arena(
+                    (1 << k,), np.uint8, name=f"{self.name}-{1 << k}")
+                ar.max_used = 0
+        return ar
+
+    def allocate(self, nbytes: int) -> DataCopy:
+        return self._arena_for(nbytes).allocate()
+
+    def arenas(self) -> List[Arena]:
+        with self._lock:
+            return list(self._classes.values())
+
+    def stats(self) -> dict:
+        out = {"cached": 0, "used": 0, "created": 0}
+        for ar in self.arenas():
+            for k, v in ar.stats().items():
+                out[k] += v
+        return out

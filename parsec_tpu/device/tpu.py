@@ -32,6 +32,7 @@ Departures from the reference, by TPU design:
 from __future__ import annotations
 
 import collections
+import contextlib
 import threading
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
@@ -164,6 +165,24 @@ class TpuDevice(Device):
             "device", "tpu_wave_batch", 2,
             help="min same-signature ready-wave size batched into one "
                  "program (0 disables wave batching)")
+        if (self._wave_min
+                and getattr(self.jdev, "platform", "") == "cpu"
+                and getattr(context, "nranks", 1) > 1):
+            try:
+                explicit = mca_param.source("device", "tpu_wave_batch") \
+                    != "default"
+            except KeyError:
+                explicit = False
+            if not explicit:
+                # multi-rank CPU emulation (N in-process ranks on virtual
+                # CPU devices): wave batching amortizes a device-enqueue
+                # RPC that does not exist here, while every (kernel, wave
+                # size) pair costs a fresh XLA compile PER RANK — on the
+                # 8-rank dpotrf bench that tripled wall clock.  Real TPU
+                # (and single-rank CPU, where the compile set is paid
+                # once) keep the default; set the MCA param to force
+                # either way.
+                self._wave_min = 0
         #: dual LRU of resident Data keyed by data_id (reference
         #: gpu_mem_lru / gpu_mem_owned_lru)
         self._lru_clean: "collections.OrderedDict[int, Data]" = collections.OrderedDict()
@@ -259,27 +278,36 @@ class TpuDevice(Device):
                     group = buckets[key] = []
                     units.append(("wave", group))
                 group.append(task)
-            for kind, item in units:
-                if kind == "single":
-                    self._submit_one(item, es)
-                    continue
-                group = item
-                if len(group) >= max(2, self._wave_min):
-                    try:
-                        self._submit_wave(group, es)
+            # completions issued below run release_deps inline: a
+            # coalescing window batches every activation this drained
+            # batch produces into one frame per destination rank (the
+            # "all activations of one progress cycle" aggregation of the
+            # eager/rendezvous protocol; no-op without a comm engine)
+            comm = getattr(self.context, "comm", None)
+            win = comm.coalesce() if comm is not None \
+                else contextlib.nullcontext()
+            with win:
+                for kind, item in units:
+                    if kind == "single":
+                        self._submit_one(item, es)
                         continue
-                    except Exception as e:
-                        # only pre-dispatch failures escape _submit_wave
-                        # (staging/trace/enqueue — no task side effects
-                        # yet); per-task epilog/completion errors are
-                        # contained inside it with a loud pool fail
-                        debug.warning(
-                            "wave submit of %d tasks failed (%s); "
-                            "falling back per-task", len(group), e)
-                for t in group:
-                    if not getattr(t, "_tpu_completed", False) \
-                            and not getattr(t.taskpool, "failed", False):
-                        self._submit_one(t, es)
+                    group = item
+                    if len(group) >= max(2, self._wave_min):
+                        try:
+                            self._submit_wave(group, es)
+                            continue
+                        except Exception as e:
+                            # only pre-dispatch failures escape _submit_wave
+                            # (staging/trace/enqueue — no task side effects
+                            # yet); per-task epilog/completion errors are
+                            # contained inside it with a loud pool fail
+                            debug.warning(
+                                "wave submit of %d tasks failed (%s); "
+                                "falling back per-task", len(group), e)
+                    for t in group:
+                        if not getattr(t, "_tpu_completed", False) \
+                                and not getattr(t.taskpool, "failed", False):
+                            self._submit_one(t, es)
             # phase: get_data_out — retire ready computations in order
             progressed = self._poll_lanes(es)
             with self._lock:

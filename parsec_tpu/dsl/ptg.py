@@ -1218,7 +1218,8 @@ class PTGTaskpool(Taskpool):
                     flow_payloads = {k: to_wire(v)
                                      for k, v in flow_payloads.items()}
                 comm.remote_dep.send_activations(
-                    self, pc.name, task.locals, rank_masks, flow_payloads)
+                    self, pc.name, task.locals, rank_masks, flow_payloads,
+                    priority=task.priority)
             ready: List[Task] = []
             for succ_pc, locs in succ_list:
                 goal = succ_pc.goal_of(locs, self.constants, self._exists_memo)

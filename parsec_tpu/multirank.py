@@ -120,6 +120,36 @@ def run_multirank_perf(
             if flops is not None:
                 stats["gflops"] = round(
                     flops / max(stats["wall_s"], 1e-9) / 1e9, 3)
+            stats["activations_per_s"] = round(
+                stats["activations"] / max(stats["wall_s"], 1e-9), 1)
+            # wire-protocol summary (eager/rendezvous regime split): how
+            # the dependency payloads actually travelled, next to the
+            # tasks/s they enabled
+            eager = rdv = 0
+            wire_bytes = 0
+            proto: Dict[str, Any] = {}
+            for c in ctxs:
+                rd = getattr(c.comm, "remote_dep", None)
+                if rd is None or not hasattr(rd, "protocol_stats"):
+                    continue
+                ps = rd.protocol_stats()
+                for k, v in ps.items():
+                    if k != "eager_hit_rate":
+                        proto[k] = proto.get(k, 0) + v
+                eager += ps["eager_sent"]
+                rdv += ps["rdv_sent"]
+                wire_bytes += int(c.comm.stats.get("am_bytes", 0))
+                if not getattr(c.comm, "pull_bytes_in_frames", False):
+                    # table-served pulls (inproc) bypass AM frames; on
+                    # frame-served engines (TCP) get_bytes is already
+                    # inside am_bytes — adding it would double-count
+                    wire_bytes += int(c.comm.stats.get("get_bytes", 0))
+            if proto:
+                proto["eager_hit_rate"] = round(
+                    eager / (eager + rdv), 4) if (eager + rdv) else 1.0
+                stats["wire"] = proto
+                stats["eager_hit_rate"] = proto["eager_hit_rate"]
+                stats["wire_bytes"] = wire_bytes
         finally:
             for c in ctxs:
                 c.fini()

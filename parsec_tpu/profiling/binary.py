@@ -191,6 +191,7 @@ class RankTraceSet:
             {name: t.keyword(name) for name in
              ("exec", "prepare_input", "complete_exec", "select",
               "dep_edge", "comm_send", "comm_recv", "comm_ctl",
+              "comm_recv_eager", "comm_recv_rdv", "frame_coalesced",
               "ce_send", "ce_recv", "qdepth", "steals")}
             for t in self.traces]
         self._steals_seen: Dict[int, int] = {}
@@ -261,12 +262,19 @@ class RankTraceSet:
 
         sub(pins.RELEASE_DEPS_END, on_release)
 
-        # scheduler-side subscribers: select latency spans + steal counts
+        # scheduler-side subscribers: select latency + steal counts.
+        # Empty selects (idle polls) are NOT logged: on a waiting mesh
+        # they outnumber real selects hundreds-to-one, and every log is
+        # a native call under the GIL — round-7 profiling measured the
+        # idle-poll select spans as the single largest non-idle cost of
+        # the 8-rank dpotrf bench.  A successful select logs ONE
+        # ``select`` instant whose info carries the measured latency in
+        # ns (the span's information content, at a fraction of the
+        # events).
+        sel_t0: Dict[int, int] = {}
+
         def on_select_begin(es, _):
-            r = self._es_rank(es)
-            tr = self._trace_of(r)
-            if tr is not None:
-                tr.begin(self._k[r - self.base_rank]["select"])
+            sel_t0[id(es)] = time.monotonic_ns()
 
         def on_select_end(es, task):
             r = self._es_rank(es)
@@ -274,7 +282,10 @@ class RankTraceSet:
             if tr is None:
                 return
             ks = self._k[r - self.base_rank]
-            tr.end(ks["select"], 1 if task is not None else 0)
+            if task is not None:
+                t0 = sel_t0.get(id(es))
+                lat = (time.monotonic_ns() - t0) if t0 else 0
+                tr.instant(ks["select"], 1, lat)
             if es is not None:
                 steals = es.stats.get("steals", 0)
                 key = id(es)
@@ -298,8 +309,31 @@ class RankTraceSet:
                         int(info.get("bytes", 0)))
             return cb
 
+        def pld_cb(es, info):
+            # payload landings split BY REGIME so critpath/tools can
+            # attribute comm bytes per protocol path: comm_recv keeps
+            # the unified stream (overlap metric), comm_recv_eager /
+            # comm_recv_rdv add the tagged view.  For rdv chunks the
+            # event_id packs (chunk_index << 16 | chunk_count) — peer
+            # already rides the unified event.
+            info = info or {}
+            tr = self._trace_of(info.get("rank", 0))
+            if tr is None:
+                return
+            ks = self._k[tr.rank - self.base_rank]
+            nbytes = int(info.get("bytes", 0))
+            tr.instant(ks["comm_recv"],
+                       info.get("dst", info.get("peer", 0)) or 0, nbytes)
+            if info.get("proto") == "rdv":
+                packed = ((int(info.get("chunk", 0)) << 16)
+                          | (int(info.get("nchunks", 1)) & 0xFFFF))
+                tr.instant(ks["comm_recv_rdv"], packed, nbytes)
+            else:
+                tr.instant(ks["comm_recv_eager"],
+                           info.get("peer", 0) or 0, nbytes)
+
         sub(pins.COMM_ACTIVATE, comm_cb("comm_send"))
-        sub(pins.COMM_DATA_PLD, comm_cb("comm_recv"))
+        sub(pins.COMM_DATA_PLD, pld_cb)
         sub(pins.COMM_DATA_CTL, comm_cb("comm_ctl"))
 
         # transport spans from the comm engines (bytes/peer/queue depth)
@@ -314,6 +348,12 @@ class RankTraceSet:
                                    int(info.get("bytes", 0)))
                 if phase == "begin" and "qdepth" in info:
                     tr.counter(ks["qdepth"], int(info["qdepth"]))
+                if phase == "begin" and int(info.get("coalesced", 0)) > 1:
+                    # coalesced-frame size: how many AMs shared this
+                    # frame (event_id = peer, info = message count)
+                    tr.instant(ks["frame_coalesced"],
+                               int(info.get("peer", 0)),
+                               int(info["coalesced"]))
             return cb
 
         sub(pins.COMM_SEND_BEGIN, wire_cb("ce_send", "begin"))

@@ -84,11 +84,30 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
     preds: Dict[Tuple[Any, int], List[Tuple[Any, int]]] = defaultdict(list)
     comm_open: Dict[Tuple[Any, Any, str], float] = {}
     comm_iv: Dict[Any, List[Tuple[float, float]]] = defaultdict(list)
+    #: protocol-regime accounting from the tagged payload instants
+    #: (comm_recv_eager / comm_recv_rdv, profiling.binary): events +
+    #: bytes per wire regime, so comm time on the chain can be read
+    #: against HOW the bytes travelled
+    regimes = {"eager": {"events": 0, "bytes": 0},
+               "rdv": {"events": 0, "bytes": 0, "chunks": 0,
+                       "transfers": 0}}
 
     for e in sorted(events, key=lambda e: e.get("ts", 0.0)):
         name, ph = e.get("name"), e.get("ph")
         pid = e.get("pid")
         args = e.get("args", {}) or {}
+        if name == "comm_recv_eager" and ph == "i":
+            regimes["eager"]["events"] += 1
+            regimes["eager"]["bytes"] += int(args.get("info", 0) or 0)
+        elif name == "comm_recv_rdv" and ph == "i":
+            r = regimes["rdv"]
+            r["events"] += 1
+            r["chunks"] += 1
+            r["bytes"] += int(args.get("info", 0) or 0)
+            # event_id packs (chunk_index << 16 | chunk_count): count a
+            # transfer at its chunk 0
+            if (int(args.get("event_id", 0) or 0) >> 16) == 0:
+                r["transfers"] += 1
         if name == exec_name:
             tok = args.get("event_id")
             key = (pid, e.get("tid"), tok)
@@ -116,7 +135,7 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
     empty = {"wall_us": 0.0, "n_tasks": 0, "coverage": 0.0,
              "buckets": {"compute_us": 0.0, "comm_us": 0.0,
                          "host_gap_us": 0.0},
-             "per_class": {}, "chain": []}
+             "per_class": {}, "chain": [], "comm_regimes": regimes}
     if not tasks:
         return empty
     comm_merged = {pid: _merge_intervals(iv) for pid, iv in comm_iv.items()}
@@ -170,6 +189,7 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
         "buckets": buckets,
         "per_class": {k: dict(v) for k, v in per_class.items()},
         "chain": rows,
+        "comm_regimes": regimes,
     }
 
 
@@ -185,6 +205,14 @@ def render(report: dict) -> str:
     for k in ("compute_us", "comm_us", "host_gap_us"):
         frac = b[k] / wall if wall > 0 else 0.0
         lines.append(f"  {k[:-3]:<10} {b[k] / 1e3:>10.3f} ms  {frac:>6.1%}")
+    reg = report.get("comm_regimes")
+    if reg and (reg["eager"]["events"] or reg["rdv"]["events"]):
+        ev_e, ev_r = reg["eager"]["events"], reg["rdv"].get("transfers", 0)
+        hit = ev_e / (ev_e + ev_r) if (ev_e + ev_r) else 1.0
+        lines.append(
+            f"  wire: eager {ev_e} payloads / {reg['eager']['bytes']} B, "
+            f"rdv {ev_r} transfers / {reg['rdv'].get('chunks', 0)} chunks"
+            f" / {reg['rdv']['bytes']} B  (eager hit-rate {hit:.1%})")
     if report["per_class"]:
         lines.append(f"  {'class':<18}{'count':>6}{'compute_ms':>12}"
                      f"{'comm_ms':>10}{'host_ms':>10}{'host_us/task':>14}")

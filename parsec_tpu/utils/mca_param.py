@@ -183,6 +183,18 @@ class ParamRegistry:
             for cb in self._watchers.get(key, ()):
                 cb(p.set_value)
 
+    def source(self, framework: str, name: str) -> str:
+        """Where the current value came from: ``api`` | ``env`` | ``file``
+        | ``default`` (KeyError for unregistered params).  Lets callers
+        honor an *explicitly configured* legacy parameter over a newer
+        one's default (reference: deprecated-synonym resolution in
+        ``mca_param.c``)."""
+        with self._lock:
+            p = self._params.get(f"{framework}_{name}")
+            if p is None:
+                raise KeyError(f"unregistered mca param {framework}_{name}")
+            return p.source()
+
     def unset(self, framework: str, name: str) -> None:
         with self._lock:
             p = self._params.get(f"{framework}_{name}")
@@ -304,6 +316,7 @@ params = ParamRegistry()
 # convenience module-level API mirroring parsec_mca_param_reg_*_name
 register = params.register
 get = params.get
+source = params.source
 set_param = params.set
 load_file = params.load_file
 parse_cmdline = params.parse_cmdline

@@ -40,11 +40,7 @@ def test_pingpong_rtt():
           f"({dt/trips*1e6:.0f} us/hop incl. runtime)")
 
 
-def test_bandwidth_counts():
-    """Reference bandwidth.jdf + check-comms.py: for F transfers of L
-    bytes, the payload byte count at the CE must be exactly F*L."""
-    nranks, F, L = 2, 10, 32768  # 32KB tiles, below default short limit
-
+def _bandwidth_build(nranks, F, L):
     def build(rank, ctx):
         dc = LocalCollection("D", shape=(L // 8,), nodes=nranks, myrank=rank,
                             init=lambda k: np.zeros(L // 8))
@@ -60,10 +56,42 @@ def test_bandwidth_counts():
         rcv.body(cpu=lambda X, f: None)
         return ptg.taskpool(F=F, D=dc)
 
-    ctxs = run_ranks(nranks, build)
-    ce0 = ctxs[0].comm
+    return build
+
+
+def test_bandwidth_counts():
+    """Reference bandwidth.jdf + check-comms.py: for F transfers of L
+    bytes, the payload byte count at the CE must be exactly F*L.  32 KiB
+    tiles sit ABOVE the 8 KiB default eager limit, so the bytes travel
+    the chunked rendezvous path and are accounted at the puller's CE."""
+    nranks, F, L = 2, 10, 32768
+
+    ctxs = run_ranks(nranks, _bandwidth_build(nranks, F, L))
+    ce0, ce1 = ctxs[0].comm, ctxs[1].comm
     assert ce0.remote_dep.stats["activations_sent"] == F
+    assert ce0.remote_dep.stats["rdv_advertised"] == F
+    assert ce1.remote_dep.stats["rdv_pulls"] == F
+    assert ce1.stats["get_bytes"] == F * L  # exact payload accounting
+
+
+def test_bandwidth_counts_eager():
+    """Same shape with the eager limit raised over the tile size: every
+    payload rides inline with its activation and the byte count at the
+    SENDER's CE is exactly F*L (zero pull traffic)."""
+    from parsec_tpu.utils import mca_param
+
+    nranks, F, L = 2, 10, 32768
+    mca_param.set_param("runtime", "comm_eager_limit", 1 << 16)
+    try:
+        ctxs = run_ranks(nranks, _bandwidth_build(nranks, F, L))
+    finally:
+        mca_param.params.unset("runtime", "comm_eager_limit")
+    ce0, ce1 = ctxs[0].comm, ctxs[1].comm
+    assert ce0.remote_dep.stats["activations_sent"] == F
+    assert ce0.remote_dep.stats["eager_sent"] == F
     assert ce0.stats["am_bytes"] == F * L  # exact payload accounting
+    assert ce1.remote_dep.stats["rdv_pulls"] == 0
+    assert ce1.stats["get_bytes"] == 0
 
 
 def test_all2all():

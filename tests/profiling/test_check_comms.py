@@ -44,14 +44,23 @@ def run_bandwidth(nflows: int, length_elems: int, short_limit: int):
         return prof.trace.to_dataframe()
     finally:
         prof.uninstall()
-        mca_param.set_param("runtime", "comm_short_limit", 1 << 16)
+        # UNSET, never set-back-to-default: an explicitly-set legacy
+        # comm_short_limit overrides the eager limit for every context
+        # created later in this process (remote_dep's deprecation shim)
+        mca_param.params.unset("runtime", "comm_short_limit")
 
 
 def test_comm_trace_counts_large_payloads():
     """check-comms.py shape: F=10 flows of L=2097152 bytes each via the
-    one-sided GET path; counts and byte sums must be exact."""
+    chunked rendezvous path; counts and byte sums must be exact,
+    including the per-chunk pipeline traffic."""
     F, L_ELEMS = 10, 262144  # 262144 float64 = 2 MiB per payload
-    df = run_bandwidth(F, L_ELEMS, short_limit=1024)
+    mca_param.set_param("runtime", "comm_rdv_chunk", 512 << 10)
+    nchunks = (L_ELEMS * 8) // (512 << 10)  # 4 chunks per transfer
+    try:
+        df = run_bandwidth(F, L_ELEMS, short_limit=1024)
+    finally:
+        mca_param.params.unset("runtime", "comm_rdv_chunk")
 
     act = df[df["name"] == "MPI_ACTIVATE"]
     ctl = df[df["name"] == "MPI_DATA_CTL"]
@@ -62,12 +71,16 @@ def test_comm_trace_counts_large_payloads():
     # local + 2*0 forward entries) = 20 bytes each
     assert len(act) == F
     assert act["bytes"].sum() == F * 20
-    # every payload above the short limit advertises exactly one GET
-    assert len(ctl) == F
-    # payload bytes delivered: exactly F * 2 MiB, all via the get path
-    assert len(pld) == F
+    # every payload above the eager limit advertises exactly one
+    # rendezvous transfer (sender side) and pulls nchunks chunk
+    # requests (receiver side) — both on the CTL channel
+    assert len(ctl) == F + F * nchunks
+    # payload bytes delivered: exactly F * 2 MiB, one PLD per chunk
+    assert len(pld) == F * nchunks
     assert pld["bytes"].sum() == F * L_ELEMS * 8 == F * 2097152
-    assert set(pld["kind"]) == {"get"}
+    assert set(pld["kind"]) == {"rdv"}
+    # every chunk index 0..nchunks-1 of every transfer arrived
+    assert sorted(set(pld["chunk"])) == list(range(nchunks))
 
 
 def test_comm_trace_counts_inline_payloads():
@@ -81,7 +94,7 @@ def test_comm_trace_counts_inline_payloads():
     pld = df[df["name"] == "MPI_DATA_PLD"]
     assert len(pld) == F
     assert pld["bytes"].sum() == F * L_ELEMS * 8
-    assert set(pld["kind"]) == {"inline"}
+    assert set(pld["kind"]) == {"eager"}
 
 
 def test_comm_trace_counts_dtd_channel():
@@ -123,7 +136,7 @@ def test_comm_trace_counts_dtd_channel():
     # each hop k=1..n-1 ships tile k-1 to the other rank, plus flush
     # traffic home; every shipped payload is W*8 bytes and inlines
     assert len(act) == len(pld) >= n - 1
-    assert set(pld["kind"]) == {"inline"}
+    assert set(pld["kind"]) == {"eager"}
     assert pld["bytes"].sum() == len(pld) * W * 8
 
 

@@ -1,0 +1,412 @@
+"""Eager/rendezvous wire-protocol regression (round-7 tentpole).
+
+Pins the two-regime data plane at the protocol layer, engine-agnostically:
+the eager threshold decides INLINE vs chunked rendezvous exactly at the
+byte boundary; sub-threshold payloads provably never touch the GET
+machinery (pin-verified); rendezvous chunks reassemble out of order; and
+the in-process fabric speaks the SAME protocol as the TCP wire (parity:
+identical results AND identical protocol-pin sequences for one graph run
+over both engines).
+"""
+
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm.engine import CommEngine
+from parsec_tpu.comm.inproc import InprocComm, InprocFabric
+from parsec_tpu.comm.payload import (
+    as_bytes, from_wire, raw_framable, wire_header,
+)
+from parsec_tpu.comm.remote_dep import RemoteDepManager, _RdvPull
+from parsec_tpu.profiling import pins
+from parsec_tpu.utils import mca_param
+
+
+def _wait(pred, timeout=20):
+    deadline = time.time() + timeout
+    while not pred():
+        time.sleep(0.005)
+        assert time.time() < deadline, "timed out"
+
+
+class _SinkPool:
+    """Minimal taskpool surface for protocol-level tests."""
+
+    def __init__(self, name="pp"):
+        self.name = name
+        self.got = []
+        self.context = None
+
+    def incoming_activation(self, **kw):
+        self.got.append(kw)
+
+    def incoming_writeback(self, *a, **kw):
+        pass
+
+    def _force_fail(self):
+        return True
+
+
+def _rd_pair():
+    """Two inproc endpoints with protocol managers + a sink pool on
+    rank 1 (and the same-named pool on rank 0 for the send side)."""
+    fabric = InprocFabric(2)
+    ces = fabric.endpoints()
+    rds = [RemoteDepManager(ce) for ce in ces]
+    pools = [_SinkPool(), _SinkPool()]
+    rds[0].new_taskpool(pools[0])
+    rds[1].new_taskpool(pools[1])
+    return ces, rds, pools
+
+
+# -- eager threshold boundary -------------------------------------------
+
+def test_eager_threshold_boundary():
+    """limit-1 and limit bytes ride eager (zero pull traffic); limit+1
+    goes rendezvous — and every size roundtrips value-exact."""
+    ces, rds, pools = _rd_pair()
+    limit = rds[0].eager_limit
+    for nbytes, want in ((limit - 1, "eager"), (limit, "eager"),
+                         (limit + 1, "rdv")):
+        e0 = int(rds[0].stats["eager_sent"])
+        r0 = int(rds[0].stats["rdv_advertised"])
+        payload = np.arange(nbytes, dtype=np.uint8)
+        rds[0].send_activations(pools[0], "cls", (nbytes,), {1: 1},
+                                {0: payload})
+        ces[1].progress_nonblocking()
+        _wait(lambda: pools[1].got)
+        kw = pools[1].got.pop()
+        np.testing.assert_array_equal(kw["flow_data"][0], payload)
+        if want == "eager":
+            assert rds[0].stats["eager_sent"] == e0 + 1
+            assert rds[0].stats["rdv_advertised"] == r0
+        else:
+            assert rds[0].stats["eager_sent"] == e0
+            assert rds[0].stats["rdv_advertised"] == r0 + 1
+            assert rds[1].stats["rdv_pulls"] >= 1
+    # use-counted rendezvous registrations fully self-reclaimed
+    assert not ces[0].fabric.mem
+
+
+def test_subthreshold_zero_get_roundtrips_pinned():
+    """Pin-verified eager fast path: a sub-threshold payload produces NO
+    GET round trips — zero DATA_CTL events, zero pull stats, and its one
+    DATA_PLD event is tagged proto=eager.  Over the REAL TCP wire, the
+    internal GET_REQ/GET_ANS tags must never fire either."""
+    from parsec_tpu.comm.tcp import TCPComm, TAG_GET_REQ, TAG_GET_ANS
+
+    seen = {"ctl": [], "pld": []}
+    ctl_cb = lambda es, info: seen["ctl"].append(info)
+    pld_cb = lambda es, info: seen["pld"].append(info)
+    pins.subscribe(pins.COMM_DATA_CTL, ctl_cb)
+    pins.subscribe(pins.COMM_DATA_PLD, pld_cb)
+    rdv_dir = tempfile.mkdtemp()
+    ces = [None, None]
+
+    def mk(r):
+        ces[r] = TCPComm(r, 2, rendezvous_dir=rdv_dir)
+
+    ts = [threading.Thread(target=mk, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    try:
+        rds = [RemoteDepManager(ce) for ce in ces]
+        pools = [_SinkPool(), _SinkPool()]
+        rds[0].new_taskpool(pools[0])
+        rds[1].new_taskpool(pools[1])
+        payload = np.arange(512, dtype=np.float64)  # 4 KiB < 8 KiB limit
+        rds[0].send_activations(pools[0], "cls", (7,), {1: 1}, {0: payload})
+        _wait(lambda: pools[1].got)
+        np.testing.assert_array_equal(pools[1].got[0]["flow_data"][0],
+                                      payload)
+        assert seen["ctl"] == []                       # no pull requests
+        assert [p["proto"] for p in seen["pld"]] == ["eager"]
+        assert rds[1].stats["rdv_pulls"] == 0
+        assert rds[0].stats["get_advertised"] == 0
+        # the wire never carried the GET handshake tags
+        for ce in ces:
+            assert ce.stats[f"am_sent_{TAG_GET_REQ}"] == 0
+            assert ce.stats[f"am_sent_{TAG_GET_ANS}"] == 0
+    finally:
+        pins.unsubscribe(pins.COMM_DATA_CTL, ctl_cb)
+        pins.unsubscribe(pins.COMM_DATA_PLD, pld_cb)
+        ts = [threading.Thread(target=ce.close) for ce in ces if ce]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+
+# -- rendezvous chunking ------------------------------------------------
+
+class _ShuffledEngine(CommEngine):
+    """Fake engine that DEFERS chunk answers and releases them in an
+    adversarial order; records the in-flight high-water mark so the
+    pipeline-depth cap is pinned too."""
+
+    rank, nranks = 1, 2
+    device_payloads = False
+
+    def __init__(self, src: np.ndarray):
+        self._init_protocol()
+        self.src = as_bytes(src)
+        self.pending = []
+        self.inflight_max = 0
+
+    def register_am(self, tag, cb):
+        pass
+
+    def get_part(self, src_rank, handle, offset, length, on_done,
+                 fin=False, priority=0):
+        self.pending.append((on_done, offset, length))
+        self.inflight_max = max(self.inflight_max, len(self.pending))
+
+    def release_all_reversed(self):
+        while self.pending:
+            batch, self.pending = self.pending[::-1], []
+            for on_done, off, ln in batch:
+                on_done(self.src[off:off + ln].copy())
+
+
+def test_rdv_chunks_reassemble_out_of_order():
+    """Chunk answers landing in reverse order still reassemble exactly,
+    and the pull never exceeds comm_pipeline_depth in-flight requests."""
+    mca_param.set_param("runtime", "comm_rdv_chunk", 1024)
+    mca_param.set_param("runtime", "comm_pipeline_depth", 3)
+    try:
+        tile = np.random.default_rng(5).standard_normal((40, 33))  # 10560 B
+        ce = _ShuffledEngine(tile)
+        mgr = RemoteDepManager(ce)
+        out = []
+        _RdvPull(mgr, 0, {"handle": "h", "hdr": wire_header(tile),
+                          "nbytes": tile.nbytes}, out.append)
+        # 11 chunks of <=1024 B, 3 in flight: drain adversarially
+        while ce.pending:
+            ce.release_all_reversed()
+        assert out and out[0] is not None
+        np.testing.assert_array_equal(out[0], tile)
+        assert ce.inflight_max <= 3
+        assert mgr.stats["rdv_chunks_req"] == 11
+    finally:
+        mca_param.params.unset("runtime", "comm_rdv_chunk")
+        mca_param.params.unset("runtime", "comm_pipeline_depth")
+
+
+class _ThreadedEngine(CommEngine):
+    """Fake engine answering every chunk from its OWN thread — the
+    cross-thread shape (TCP: requester thread pumps, comm thread
+    completes) that can lose a wakeup if the pump's re-entrancy flag
+    swallows a completion's refill."""
+
+    rank, nranks = 1, 2
+    device_payloads = False
+
+    def __init__(self, src):
+        self._init_protocol()
+        self.src = as_bytes(src)
+
+    def register_am(self, tag, cb):
+        pass
+
+    def get_part(self, src_rank, handle, offset, length, on_done,
+                 fin=False, priority=0):
+        def answer():
+            time.sleep(0.0005)
+            on_done(self.src[offset:offset + length].copy())
+
+        threading.Thread(target=answer, daemon=True).start()
+
+
+def test_rdv_cross_thread_completions_never_stall():
+    """Chunk completions arriving from another thread must keep the
+    pipeline full: the transfer completes even when a completion races
+    the pump's re-entrancy flag (lost-wakeup regression)."""
+    mca_param.set_param("runtime", "comm_rdv_chunk", 1024)
+    mca_param.set_param("runtime", "comm_pipeline_depth", 2)
+    try:
+        tile = np.random.default_rng(9).standard_normal(8192)  # 64 chunks
+        ce = _ThreadedEngine(tile)
+        mgr = RemoteDepManager(ce)
+        done = threading.Event()
+        out = []
+
+        def cb(arr):
+            out.append(arr)
+            done.set()
+
+        _RdvPull(mgr, 0, {"handle": "h", "hdr": wire_header(tile),
+                          "nbytes": tile.nbytes}, cb)
+        assert done.wait(20), "rendezvous pull stalled (lost wakeup)"
+        np.testing.assert_array_equal(out[0], tile)
+    finally:
+        mca_param.params.unset("runtime", "comm_rdv_chunk")
+        mca_param.params.unset("runtime", "comm_pipeline_depth")
+
+
+def test_rdv_failed_chunk_reports_none_once():
+    """A failed chunk (source gone) resolves the transfer as None exactly
+    once; stragglers of the same transfer are ignored."""
+    mca_param.set_param("runtime", "comm_rdv_chunk", 16 << 10)
+    try:
+        tile = np.arange(4096, dtype=np.float64)  # 32 KiB -> 2 chunks
+        ce = _ShuffledEngine(tile)
+        mgr = RemoteDepManager(ce)
+        out = []
+        _RdvPull(mgr, 0, {"handle": "h", "hdr": wire_header(tile),
+                          "nbytes": tile.nbytes}, out.append)
+        (cb0, *_), (cb1, *_) = ce.pending[0], ce.pending[1]
+        cb0(None)
+        cb1(None)  # straggler after the failure
+        assert out == [None]
+        # the failed consumer released its use of the registration with a
+        # zero-length fin read (no leaked producer-side pins)
+        assert any(ln == 0 for _cb, _off, ln in ce.pending)
+    finally:
+        mca_param.params.unset("runtime", "comm_rdv_chunk")
+
+
+# -- wire framing helpers -----------------------------------------------
+
+def test_raw_framing_roundtrip_orders_and_fallback():
+    """Header+raw-bytes framing roundtrips C- and F-order arrays and
+    zero-size arrays as views; non-contiguous views and object dtypes
+    are NOT framable (they take the pickle/datatype-pack fallback)."""
+    c = np.arange(12.0).reshape(3, 4)
+    f = np.asfortranarray(c)
+    z = np.empty((0, 5), dtype=np.float32)
+    for arr in (c, f, z, np.float32(3.5) * np.ones(7)):
+        assert raw_framable(arr)
+        back = from_wire(wire_header(arr), as_bytes(arr).copy())
+        np.testing.assert_array_equal(back, arr)
+        assert back.dtype == arr.dtype and back.shape == arr.shape
+    assert not raw_framable(c[:, ::2])          # non-contiguous
+    assert not raw_framable(np.array([{"a": 1}], dtype=object))
+    assert not raw_framable([1, 2, 3])          # not an ndarray
+
+
+# -- MCA validation -----------------------------------------------------
+
+@pytest.mark.parametrize("name,bad", [
+    ("comm_pipeline_depth", 0),
+    ("comm_pipeline_depth", -2),
+    ("comm_eager_limit", -1),
+    ("comm_rdv_chunk", 0),
+])
+def test_protocol_params_validated_at_construction(name, bad):
+    """0/negative protocol params are rejected with a readable error at
+    ENGINE construction — not discovered as a hang on the first large
+    transfer."""
+    mca_param.set_param("runtime", name, bad)
+    try:
+        with pytest.raises(ValueError, match=name):
+            InprocFabric(2).endpoints()
+    finally:
+        mca_param.params.unset("runtime", name)
+    InprocFabric(2).endpoints()  # healthy again after the unset
+
+
+# -- engine parity ------------------------------------------------------
+
+def _run_graph_on(ces, collect):
+    """One two-rank producer/consumer graph with one sub- and one
+    above-threshold flow; returns the consumer's received arrays."""
+    rds = [RemoteDepManager(ce) for ce in ces]
+    pools = [_SinkPool("parity"), _SinkPool("parity")]
+    rds[0].new_taskpool(pools[0])
+    rds[1].new_taskpool(pools[1])
+    small = np.arange(256, dtype=np.float64)          # 2 KiB  -> eager
+    big = np.arange(64 << 7, dtype=np.float64)        # 64 KiB -> rdv
+    rds[0].send_activations(pools[0], "cls", (1,), {1: 0b11},
+                            {0: small, 1: big})
+    for _ in range(200):
+        if pools[1].got:
+            break
+        for ce in ces:
+            try:
+                ce.progress_nonblocking()
+            except NotImplementedError:
+                pass
+        time.sleep(0.005)
+    assert pools[1].got, "activation never delivered"
+    kw = pools[1].got[0]
+    return kw["flow_data"][0], kw["flow_data"][1]
+
+
+@pytest.mark.parametrize("engine", ["inproc", "tcp"])
+def test_engine_parity_same_protocol_pins(engine):
+    """The SAME graph over the in-process fabric and the TCP wire takes
+    identical regime decisions: identical results, identical protocol-pin
+    sequences (site, proto, chunk-shape) — so tier-1 inproc tests really
+    exercise the wire protocol."""
+    events = []
+
+    def on_pld(es, info):
+        events.append(("pld", info.get("proto"),
+                       info.get("chunk"), info.get("nchunks"),
+                       int(info.get("bytes", 0))))
+
+    def on_ctl(es, info):
+        events.append(("ctl", info.get("proto"),
+                       info.get("chunk"), info.get("nchunks"),
+                       int(info.get("bytes", 0))))
+
+    pins.subscribe(pins.COMM_DATA_PLD, on_pld)
+    pins.subscribe(pins.COMM_DATA_CTL, on_ctl)
+    try:
+        if engine == "inproc":
+            ces = InprocFabric(2).endpoints()
+            close = lambda: None
+        else:
+            rdv_dir = tempfile.mkdtemp()
+            ces = [None, None]
+
+            def mk(r):
+                ces[r] = __import__(
+                    "parsec_tpu.comm.tcp", fromlist=["TCPComm"]
+                ).TCPComm(r, 2, rendezvous_dir=rdv_dir)
+
+            ts = [threading.Thread(target=mk, args=(r,)) for r in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+
+            def close():
+                cs = [threading.Thread(target=ce.close) for ce in ces]
+                for t in cs:
+                    t.start()
+                for t in cs:
+                    t.join()
+        try:
+            small, big = _run_graph_on(ces, events)
+            np.testing.assert_array_equal(small,
+                                          np.arange(256, dtype=np.float64))
+            np.testing.assert_array_equal(big,
+                                          np.arange(64 << 7,
+                                                    dtype=np.float64))
+        finally:
+            close()
+    finally:
+        pins.unsubscribe(pins.COMM_DATA_PLD, on_pld)
+        pins.unsubscribe(pins.COMM_DATA_CTL, on_ctl)
+    key = lambda e: (e[0], str(e[1]),
+                     -1 if e[2] is None else e[2],
+                     -1 if e[3] is None else e[3], e[4])
+    test_engine_parity_same_protocol_pins._seqs = getattr(
+        test_engine_parity_same_protocol_pins, "_seqs", {})
+    test_engine_parity_same_protocol_pins._seqs[engine] = sorted(events,
+                                                                 key=key)
+    seqs = test_engine_parity_same_protocol_pins._seqs
+    # the protocol itself is engine-invariant: one eager landing, one rdv
+    # advertisement + its chunk train, byte-for-byte identical tags
+    assert [e for e in seqs[engine] if e[0] == "pld"] == sorted(
+        [("pld", "eager", None, None, 2048),
+         ("pld", "rdv", 0, 1, 64 << 10)], key=key)
+    if len(seqs) == 2:
+        assert seqs["inproc"] == seqs["tcp"]

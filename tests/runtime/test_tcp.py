@@ -143,8 +143,8 @@ def test_tcp_dist_segchol_2ranks():
 
 
 @pytest.mark.parametrize("nb,kinds", [
-    (48, ["get"]),      # 18432-B tiles: every payload takes the GET path
-    (16, ["inline"]),   # 2048-B tiles: everything inlines
+    (48, ["rdv"]),      # 18432-B tiles: every payload goes rendezvous
+    (16, ["eager"]),    # 2048-B tiles: everything rides eager
 ])
 def test_tcp_dtt_pingpong_mixed_layouts(nb, kinds):
     """dtt_bug_replicator-class regression (reference
