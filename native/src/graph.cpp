@@ -94,6 +94,10 @@ struct Graph {
     std::atomic<int64_t> n_steals_remote{0};  // cross-VP subset
     std::atomic<int64_t> n_executed{0};
     std::atomic<int64_t> n_inserted{0};
+    //: signals the double-complete guard REFUSED (a second pz_task_done
+    //: for one task): 0 on a healthy run; the hb-check/TSan harnesses
+    //: read it to prove the guard actually fired under a seeded race
+    std::atomic<int64_t> n_double_completes{0};
     std::atomic<bool> sealed{false};
     std::atomic<bool> failed{false};
 
@@ -446,7 +450,10 @@ int pz_task_done(void* gp, int64_t id) {
         // atomic claim: two racing signals for the same task must resolve
         // to exactly one release pass (complete() re-stores done=true,
         // which is idempotent)
-        if (t->done.exchange(true, std::memory_order_acq_rel)) return -2;
+        if (t->done.exchange(true, std::memory_order_acq_rel)) {
+            g->n_double_completes.fetch_add(1, std::memory_order_relaxed);
+            return -2;
+        }
     }
     // wid = -1: the caller is not a worker, so newly-ready successors go
     // to the shared queue; the "kept" successor has no worker to run on
@@ -483,6 +490,14 @@ int64_t pz_graph_run_noop(void* gp, int32_t nthreads) {
 
 int64_t pz_graph_executed(void* gp) {
     return static_cast<Graph*>(gp)->n_executed.load(std::memory_order_acquire);
+}
+
+// Refused double-completion signals (the atomic claim in pz_task_done
+// rejected a second signal for one task).  0 on a healthy run — the
+// runtime race checkers pin this.
+int64_t pz_graph_double_completes(void* gp) {
+    return static_cast<Graph*>(gp)->n_double_completes.load(
+        std::memory_order_relaxed);
 }
 
 // Dependency-respecting, priority-greedy linearisation into out[0..n).

@@ -18,6 +18,18 @@ Entry points:
 * :mod:`.edges` — the declared-DAG enumeration shared with the runtime
   :class:`parsec_tpu.profiling.checkers.IteratorsChecker`, so static and
   dynamic checkers can never disagree about the declared edges.
+
+Runtime-concurrency layer (``RT0xx`` finding codes):
+
+* :mod:`.hb` — vector-clock happens-before race checker over the
+  runtime's PINS event streams: live (``PARSEC_TPU_HBCHECK=1|strict``)
+  or post-hoc over binary traces (``tools hbcheck rank0.pbt ...``);
+* :mod:`.schedules` — deterministic schedule explorer: seeded
+  perturbations of pop order / completion timing / frame delivery, with
+  bit-identical-results + clean-hb-check assertions per seed;
+* :mod:`.lockdep` — lock-order checker for the Python side
+  (``PARSEC_TPU_LOCKDEP=1``); the native side's flavor is the
+  ThreadSanitizer build (``PARSEC_TPU_NATIVE_TSAN=1``).
 """
 
 from .findings import CODES, ERROR, WARNING, Finding, LintError, errors_of
@@ -34,11 +46,28 @@ __all__ = [
     "ERROR",
     "WARNING",
     "Finding",
+    "HBRecorder",
     "LintError",
     "SynthCollection",
+    "analyze_trace",
     "collection_names",
     "errors_of",
+    "explore",
     "lint_jdf",
     "synthesize_collections",
     "verify_ptg",
 ]
+
+
+def __getattr__(name):
+    # concurrency-layer entry points: lazy, so `import parsec_tpu.analysis`
+    # stays light for lint-only consumers (jdfc, the PTG attach hook)
+    if name in ("HBRecorder", "analyze_trace"):
+        from . import hb
+
+        return getattr(hb, name)
+    if name == "explore":
+        from .schedules import explore
+
+        return explore
+    raise AttributeError(name)

@@ -52,6 +52,28 @@ CODES = {
                         "instance-level checks were skipped"),
     "PTG051": (ERROR, "graph instantiation failed while evaluating "
                       "dependency expressions"),
+    # RT0xx: RUNTIME findings (analysis.hb happens-before checker,
+    # analysis.lockdep) — unordered pairs of runtime events, not graph
+    # defects.  Same append-only contract as PTGxxx.
+    "RT001": (ERROR, "unordered conflicting writes to the same tile "
+                     "version: two version commits with no happens-before "
+                     "path between them (the payload writes race)"),
+    "RT002": (ERROR, "arena slot recycled twice with no intervening "
+                     "allocation (a finalizer racing an explicit release "
+                     "would corrupt the free list)"),
+    "RT003": (ERROR, "dependency counter decremented after its task "
+                     "already fired (duplicate or late release: the "
+                     "successor ran without this input, or would fire "
+                     "twice)"),
+    "RT004": (WARNING, "comm frame delivered with no matching send event "
+                       "(incomplete trace, or a transport path bypassing "
+                       "the frame protocol)"),
+    "RT005": (ERROR, "native task_done accepted twice for one task "
+                     "(double-complete guard bypassed: successors would "
+                     "double-release)"),
+    "RT010": (ERROR, "inconsistent lock acquisition order between two "
+                     "lock sites (A->B and B->A both observed: potential "
+                     "deadlock)"),
 }
 
 

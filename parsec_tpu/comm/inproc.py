@@ -107,6 +107,10 @@ class InprocComm(CommEngine):
         self._outbox: Dict[int, List[Tuple[int, int, int, Any]]] = \
             collections.defaultdict(list)
         self._out_seq = 0
+        #: frame ids: (src_rank << 32 | seq), stamped on every inbox
+        #: frame so the hb checker can pair each delivery with its send
+        #: (pins.HB_FRAME_SEND/DELIVER — the cross-rank ordering edge)
+        self._frame_seq = 0
         #: window nesting is PER-THREAD: only the opener's own sends
         #: buffer until its close.  An engine-wide window would park
         #: every other thread's sends behind whatever the opener is
@@ -168,6 +172,8 @@ class InprocComm(CommEngine):
     def _flush(self, dst_rank: int) -> None:
         with self._out_lock:
             items = self._outbox.pop(dst_rank, None)
+            self._frame_seq += 1
+            fid = (self.rank << 32) | self._frame_seq
         if not items:
             return
         items.sort(key=lambda it: (-it[0], it[1]))  # priority, then FIFO
@@ -186,8 +192,13 @@ class InprocComm(CommEngine):
                       {"rank": self.rank, "peer": dst_rank,
                        "bytes": nbytes, "coalesced": len(batch),
                        "qdepth": self.fabric.inboxes[dst_rank].qsize()})
+        if pins.active(pins.HB_FRAME_SEND):
+            # happens-before edge source: everything this rank did before
+            # the frame left is visible to whatever its delivery triggers
+            pins.fire(pins.HB_FRAME_SEND, None,
+                      {"rank": self.rank, "peer": dst_rank, "frame": fid})
         self.fabric.inboxes[dst_rank].put(
-            (self.rank, batch, self._pb_outgoing()))
+            (self.rank, batch, self._pb_outgoing(), fid))
         if wire:
             pins.fire(pins.COMM_SEND_END, None,
                       {"rank": self.rank, "peer": dst_rank,
@@ -271,9 +282,13 @@ class InprocComm(CommEngine):
             with self.coalesce():
                 while True:
                     try:
-                        src, batch, pb = inbox.get_nowait()
+                        src, batch, pb, fid = inbox.get_nowait()
                     except queue.Empty:
                         break
+                    if pins.active(pins.HB_FRAME_DELIVER):
+                        pins.fire(pins.HB_FRAME_DELIVER, None,
+                                  {"rank": self.rank, "peer": src,
+                                   "frame": fid})
                     self._pb_incoming(src, pb)
                     nbytes = sum(_payload_bytes(p) for _t, p in batch)
                     # recv span: covers the frame's dispatch

@@ -268,8 +268,12 @@ class RemoteDepManager:
             "runtime", "comm_pipeline_depth", PIPELINE_DEPTH_DEFAULT)))
         self.rdv_chunk = max(1, int(mca_param.register(
             "runtime", "comm_rdv_chunk", RDV_CHUNK_DEFAULT)))
-        #: landing buffers for rendezvous payloads (recycled size classes)
-        self._rx_pool = BytePool("rdv-rx")
+        #: landing buffers for rendezvous payloads (recycled size
+        #: classes).  Rank-qualified name: slot lifecycle events
+        #: (pins.ARENA_ALLOC/RECYCLE — the hb-check double-recycle
+        #: detector, which watches exactly the finalizer-driven recycle
+        #: _RdvPull rides) name the endpoint, not just "rdv-rx"
+        self._rx_pool = BytePool(f"rdv-rx{getattr(ce, 'rank', 0)}")
         self.bcast_topo = str(mca_param.register(
             "runtime", "bcast_topo", "binomial",
             choices=["star", "chain", "binomial"],

@@ -76,7 +76,9 @@ TAG_GET_ANS = MAX_AM_TAGS - 1     # 11
 _HDR = struct.Struct("!HHII")
 _BUFLEN = struct.Struct("!Q")
 _MAGIC = 0x9A7C
-_WIRE_VERSION = 3  # v3: control blob = (rank, batch, piggyback-or-None)
+_WIRE_VERSION = 4  # v4: control blob = (rank, batch, piggyback-or-None,
+                   # frame-id) — the id pairs each delivery with its send
+                   # for the hb-check happens-before edge
 _RANK = struct.Struct("!i")
 _MISSING = object()
 #: protocol constant: out-of-band buffers one frame may carry; the
@@ -618,9 +620,15 @@ class TCPComm(CommEngine):
     def _send_frame(self, dst: int, batch: List[Tuple[int, Any]]) -> None:
         # control structure pickles; array payloads ship out-of-band
         # as raw zero-copy memoryviews appended after the blob
+        self._frame_seq = getattr(self, "_frame_seq", 0) + 1
+        fid = (self.rank << 32) | self._frame_seq
+        if pins.active(pins.HB_FRAME_SEND):
+            pins.fire(pins.HB_FRAME_SEND, None,
+                      {"rank": self.rank, "peer": dst, "frame": fid})
         bufs: List[memoryview] = []
         blob = pickle.dumps(
-            (self.rank, _pack_arrays(batch, self.stats), self._pb_outgoing()),
+            (self.rank, _pack_arrays(batch, self.stats),
+             self._pb_outgoing(), fid),
             protocol=5,
             buffer_callback=lambda pb: bufs.append(pb.raw()) and None)
         head = (_HDR.pack(_MAGIC, _WIRE_VERSION, len(blob), len(bufs))
@@ -833,12 +841,15 @@ class TCPComm(CommEngine):
             holders.append(holder)
             views.append(memoryview(holder))
         try:
-            src, batch, pb = pickle.loads(st.ctl, buffers=views)
+            src, batch, pb, fid = pickle.loads(st.ctl, buffers=views)
         except Exception as e:
             debug.error("rank %d: undecodable frame: %s", self.rank, e)
             return 0  # finalizers recycle the slots as holders die
         finally:
             del views, holders  # only consumer chains keep slots alive now
+        if pins.active(pins.HB_FRAME_DELIVER):
+            pins.fire(pins.HB_FRAME_DELIVER, None,
+                      {"rank": self.rank, "peer": src, "frame": fid})
         self._pb_incoming(src, pb)  # state first: it describes the sender
         # as of (at latest) this frame's messages
         # recv span: one frame's dispatch (unpickle already done above;

@@ -53,6 +53,19 @@ class Context:
         nranks: int = 1,
         comm=None,
     ):
+        # opt-in runtime checkers, installed BEFORE any runtime lock or
+        # thread exists so they observe the whole context lifetime:
+        # PARSEC_TPU_HBCHECK=1|strict — happens-before race recorder
+        # (reported at fini); PARSEC_TPU_LOCKDEP=1 — lock-order checker
+        # (locks created from here on are tracked)
+        if os.environ.get("PARSEC_TPU_HBCHECK", "0") not in ("", "0"):
+            from ..analysis import hb as _hb
+
+            _hb.ensure_live()
+        if os.environ.get("PARSEC_TPU_LOCKDEP", "0") not in ("", "0"):
+            from ..analysis import lockdep as _lockdep
+
+            _lockdep.install()
         if nb_cores is None:
             nb_cores = mca_param.register(
                 "runtime", "num_cores", min(os.cpu_count() or 1, 8),
@@ -475,6 +488,22 @@ class Context:
 
         devmod.detach_devices(self)
         self.scheduler.remove(self)
+        # env-driven checker reports (no-ops unless PARSEC_TPU_HBCHECK /
+        # PARSEC_TPU_LOCKDEP installed them): findings land on the
+        # context for callers, are logged as warnings, and strict
+        # hb-check raises
+        if os.environ.get("PARSEC_TPU_HBCHECK", "0") not in ("", "0"):
+            from ..analysis import hb as _hb
+
+            self.hb_findings = _hb.live_report()
+        if os.environ.get("PARSEC_TPU_LOCKDEP", "0") not in ("", "0"):
+            from ..analysis import lockdep as _lockdep
+
+            chk = _lockdep.checker()
+            if chk is not None:
+                self.lock_findings = chk.findings()
+                for f in self.lock_findings:
+                    debug.warning("lockdep: %s", f)
         debug.verbose(3, "core", "context down")
 
     # context manager sugar

@@ -14,8 +14,27 @@ mask-mode entries when ``mask == goal_mask``.
 
 from __future__ import annotations
 
+import itertools
 import threading
 from typing import Any, Dict, Hashable, Optional, Tuple
+
+from ..profiling import pins
+
+#: stable per-tracker tokens for the hb sites: ``id(tracker)`` would be
+#: reused after GC, making a later taskpool's decrements collide with an
+#: earlier one's in the checker's fired-key state (spurious RT003)
+_HB_TOKENS = itertools.count(1)
+
+
+def _fire_dep_dec(tracker: "DepTracker | DenseDepTracker", key: Hashable,
+                  ready: bool, mode: str) -> None:
+    """Happens-before site: one dependency release observed.  MUST fire
+    while the caller still holds the entry's lock — the hb checker chains
+    decrements of one key in event order, which is only meaningful if
+    event order matches lock order (analysis/hb.py)."""
+    pins.fire(pins.DEP_DECREMENT, None,
+              {"tracker": tracker.hb_token, "key": key, "ready": ready,
+               "mode": mode})
 
 
 class DepEntry:
@@ -34,6 +53,7 @@ class DepTracker:
     SHARDS = 16
 
     def __init__(self) -> None:
+        self.hb_token = next(_HB_TOKENS)
         self._shards = [
             (threading.Lock(), {}) for _ in range(self.SHARDS)
         ]  # type: list[Tuple[threading.Lock, Dict[Hashable, DepEntry]]]
@@ -55,7 +75,10 @@ class DepTracker:
             if data is not None:
                 e.data = data
             e.count += 1
-            if e.count >= goal:
+            ready = e.count >= goal
+            if pins.active(pins.DEP_DECREMENT):
+                _fire_dep_dec(self, key, ready, "counter")
+            if ready:
                 del table[key]
                 return True, e.data
             return False, e.data
@@ -70,7 +93,10 @@ class DepTracker:
             if data is not None:
                 e.data = data
             e.mask |= bit
-            if (e.mask & goal_mask) == goal_mask:
+            ready = (e.mask & goal_mask) == goal_mask
+            if pins.active(pins.DEP_DECREMENT):
+                _fire_dep_dec(self, key, ready, "mask")
+            if ready:
                 del table[key]
                 return True, e.data
             return False, e.data
@@ -126,6 +152,7 @@ class DenseDepTracker:
     STRIPES = 16
 
     def __init__(self) -> None:
+        self.hb_token = next(_HB_TOKENS)
         #: name -> (bounds, counter/mask slots, per-slot mode tags)
         self._classes: Dict[str, Tuple[Tuple[Tuple[int, int], ...], list, bytearray]] = {}
         self._locks = [threading.Lock() for _ in range(self.STRIPES)]
@@ -174,7 +201,10 @@ class DenseDepTracker:
         _, arr, modes = self._classes[name]
         with self._locks[idx % self.STRIPES]:
             c = arr[idx] + 1
-            if c >= goal:
+            ready = c >= goal
+            if pins.active(pins.DEP_DECREMENT):
+                _fire_dep_dec(self, key, ready, "counter")
+            if ready:
                 arr[idx] = 0  # delete-on-fire, like the hash backend
                 modes[idx] = 0
                 with self._data_lock:
@@ -194,7 +224,10 @@ class DenseDepTracker:
         _, arr, modes = self._classes[name]
         with self._locks[idx % self.STRIPES]:
             m = arr[idx] | bit
-            if (m & goal_mask) == goal_mask:
+            ready = (m & goal_mask) == goal_mask
+            if pins.active(pins.DEP_DECREMENT):
+                _fire_dep_dec(self, key, ready, "mask")
+            if ready:
                 arr[idx] = 0  # delete-on-fire, like the hash backend
                 modes[idx] = 0
                 with self._data_lock:

@@ -1,6 +1,13 @@
 """``rnd`` — random-order global queue (reference ``mca/sched/rnd/
 sched_rnd_module.c:107``): inserts at random positions; a scheduler-
-robustness fuzzer more than a production policy."""
+robustness fuzzer more than a production policy.
+
+MCA param ``sched_rnd_seed`` (env ``PARSEC_MCA_sched_rnd_seed``): any
+value >= 0 seeds the RNG at install, so a schedule found by the
+schedule explorer (:mod:`parsec_tpu.analysis.schedules`) replays
+deterministically; the default (-1) stays unseeded — fresh entropy per
+install, the fuzzing behavior.
+"""
 
 from __future__ import annotations
 
@@ -8,7 +15,7 @@ import random
 import threading
 from typing import Optional
 
-from ...utils import register_component
+from ...utils import mca_param, register_component
 from .base import Scheduler
 
 
@@ -21,7 +28,13 @@ class SchedRND(Scheduler):
         super().install(context)
         self._items: list = []
         self._lock = threading.Lock()
-        self._rng = random.Random(0xC0FFEE)
+        seed = int(mca_param.register(
+            "sched", "rnd_seed", -1,
+            help="seed for the rnd scheduler's RNG (>=0 replays one "
+                 "schedule deterministically — the schedule explorer's "
+                 "replay hook; -1 = unseeded fuzzing)"))
+        self.seed: Optional[int] = None if seed < 0 else seed
+        self._rng = random.Random(self.seed)  # Random(None) = fresh entropy
 
     def schedule(self, es, tasks, distance: int = 0) -> None:
         with self._lock:

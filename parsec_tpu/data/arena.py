@@ -13,8 +13,20 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
+from ..profiling import pins
 from ..utils import mca_param
 from .data import Data, DataCopy
+
+#: DataCopy.flags bit: this copy's buffer has been returned to its arena.
+#: A second release of the same copy would append the buffer to the free
+#: list twice — two future allocations would then alias one buffer and
+#: silently corrupt each other (the finalizer-vs-explicit-release race).
+RECYCLED_FLAG = 0x1
+
+
+class ArenaRecycleError(RuntimeError):
+    """A pooled buffer was recycled twice (double release of one
+    DataCopy — typically a finalizer racing an explicit ``release``)."""
 
 
 class Arena:
@@ -54,15 +66,43 @@ class Arena:
         d = Data(key, shape=self.shape, dtype=self.dtype)
         copy = d.attach_copy(0, buf)
         copy.arena = self
+        if pins.active(pins.ARENA_ALLOC):
+            pins.fire(pins.ARENA_ALLOC, None,
+                      {"arena": self.name, "slot": d.data_id})
         return copy
 
     def release(self, copy: DataCopy) -> None:
+        """Return ``copy``'s buffer to the free list.  A slot may be
+        recycled exactly once per allocation: the second release raises a
+        readable :class:`ArenaRecycleError` instead of silently pushing
+        the buffer onto the free list twice (two future allocations would
+        alias one buffer)."""
+        with self._lock:
+            if copy.flags & RECYCLED_FLAG:
+                raise ArenaRecycleError(
+                    f"arena {self.name}: slot {copy.data.key!r} "
+                    f"(data_id={copy.data.data_id}) recycled twice — a "
+                    "finalizer racing an explicit release?  The second "
+                    "release was refused; the free list is intact.")
+            copy.flags |= RECYCLED_FLAG
+        self._recycle(copy)
+
+    def _recycle(self, copy: DataCopy) -> None:
+        """Unguarded recycle (the pre-guard behavior).  Split out so the
+        hb-check test fixture can exercise the checker with the guard
+        intentionally bypassed; production callers go through
+        :meth:`release`."""
         buf = copy.payload
         copy.payload = None
         with self._lock:
             self.nb_used -= 1
             if buf is not None and len(self._free) < self.max_cached:
                 self._free.append(buf)
+            if pins.active(pins.ARENA_RECYCLE):
+                # fired under the freelist lock: the hb checker chains
+                # same-slot events in event order (analysis/hb.py)
+                pins.fire(pins.ARENA_RECYCLE, None,
+                          {"arena": self.name, "slot": copy.data.data_id})
 
     def stats(self) -> dict:
         with self._lock:

@@ -18,6 +18,7 @@ import threading
 from typing import Any, Dict, Optional, TYPE_CHECKING
 
 from ..core.lifecycle import AccessMode
+from ..profiling import pins
 
 if TYPE_CHECKING:  # pragma: no cover
     from .collection import DataCollection
@@ -187,7 +188,15 @@ class Data:
             copy.version = newv
             copy.coherency = Coherency.OWNED
             self.owner_device = device_index
-            return newv
+        # happens-before site: a write to this tile retired.  The hb
+        # checker flags two bumps with no dependency/completion/frame
+        # path between them (RT001) — the version counter itself is
+        # lock-serialized, but the payload writes it summarizes are not.
+        if pins.active(pins.DATA_VERSION_BUMP):
+            pins.fire(pins.DATA_VERSION_BUMP, None,
+                      {"data": self.data_id, "key": self.key,
+                       "version": newv, "device": device_index})
+        return newv
 
     def __repr__(self) -> str:
         return f"Data(key={self.key}, copies={list(self.copies)})"

@@ -905,6 +905,12 @@ class TpuDevice(Device):
         stays OWNED on device; host pulls on demand).  A flow's custom
         stage_out hook transforms the body output first (scatter a packed
         subtile back — reference stage_custom.jdf)."""
+        if pins.active(pins.DEVICE_EPILOG_BEGIN):
+            # happens-before join point: the manager thread is about to
+            # commit this task's outputs (version bumps) — hb-check must
+            # order them after the task's exec, which may have run on a
+            # different (worker) thread (analysis/hb.py)
+            pins.fire(pins.DEVICE_EPILOG_BEGIN, None, inflight.task)
         for (pos, data), arr, so in zip(inflight.out_specs,
                                         inflight.outputs,
                                         inflight.out_hooks):

@@ -21,16 +21,28 @@ every consumer has a pure-Python fallback path.
 from __future__ import annotations
 
 import ctypes
+import itertools
 import os
 import subprocess
 import threading
 from typing import Any, Callable, List, Optional, Sequence
 
+from ..profiling import pins
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(os.path.dirname(_HERE))
 _SRC_DIR = os.path.join(_REPO, "native", "src")
 _BUILD_DIR = os.path.join(_REPO, "native", "build")
-_LIB_PATH = os.path.join(_BUILD_DIR, "libparsec_core.so")
+#: PARSEC_TPU_NATIVE_TSAN=1 selects the ThreadSanitizer build flavor:
+#: same sources, ``-fsanitize=thread``, its own .so so the flavors never
+#: clobber each other.  Run the process under the sanitizer runtime
+#: (``LD_PRELOAD=libtsan.so`` or a tsan-instrumented interpreter) with
+#: ``TSAN_OPTIONS=suppressions=native/tsan.supp`` (see docs/USERGUIDE
+#: §10 "Checking your runtime").
+_TSAN = bool(os.environ.get("PARSEC_TPU_NATIVE_TSAN"))
+_TSAN_SUPP = os.path.join(_REPO, "native", "tsan.supp")
+_LIB_PATH = os.path.join(
+    _BUILD_DIR, "libparsec_core_tsan.so" if _TSAN else "libparsec_core.so")
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -52,7 +64,8 @@ REQUIRED_SYMBOLS = [
     "pz_graph_new", "pz_graph_destroy", "pz_graph_add_task",
     "pz_graph_add_dep", "pz_graph_task_commit", "pz_graph_seal",
     "pz_graph_run", "pz_graph_run_async", "pz_task_done", "pz_graph_fail",
-    "pz_graph_executed", "pz_graph_set_policy", "pz_graph_steals",
+    "pz_graph_executed", "pz_graph_double_completes",
+    "pz_graph_set_policy", "pz_graph_steals",
     "pz_graph_steals_remote", "pz_graph_set_vpmap", "pz_graph_reset",
     "pz_graph_run_noop", "pz_graph_order",
     # binary tracer
@@ -65,6 +78,36 @@ def _newest_mtime(paths: Sequence[str]) -> float:
     return max(os.path.getmtime(p) for p in paths)
 
 
+def _compile(out_path: str, extra_flags: Sequence[str] = (),
+             timeout: int = 300) -> str:
+    """One compile pipeline for every flavor (default + TSan): source
+    check, mtime staleness test, g++ invocation, per-process temp file,
+    atomic publish.  Returns ``out_path``; raises RuntimeError with the
+    compiler output on failure."""
+    srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+    missing = [s for s in srcs if not os.path.exists(s)]
+    if missing:
+        raise RuntimeError(f"sources missing under {_SRC_DIR}: {missing}")
+    if os.path.exists(out_path) \
+            and os.path.getmtime(out_path) >= _newest_mtime(srcs):
+        return out_path
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    # per-process temp: concurrent builds (multi-process TCP ranks on one
+    # host) must not interleave writes before the atomic publish
+    tmp = f"{out_path}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-g", "-std=c++17", "-fPIC", "-shared", "-pthread",
+           *extra_flags, "-o", tmp, *srcs]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise RuntimeError(f"g++ invocation failed: {e}")
+    if proc.returncode != 0:
+        raise RuntimeError(f"g++ failed:\n{proc.stderr[-2000:]}")
+    os.replace(tmp, out_path)
+    return out_path
+
+
 def _build() -> Optional[str]:
     """Compile the shared library if missing/stale; returns its path or
     None (recording the failure for diagnostics)."""
@@ -74,28 +117,12 @@ def _build() -> Optional[str]:
         # every consumer exercises its pure-Python path
         _build_error = "disabled via PARSEC_TPU_NATIVE_DISABLE"
         return None
-    srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
-    if not all(os.path.exists(s) for s in srcs):
-        _build_error = f"sources missing under {_SRC_DIR}"
-        return None
-    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= _newest_mtime(srcs):
-        return _LIB_PATH
-    os.makedirs(_BUILD_DIR, exist_ok=True)
-    # per-process temp: concurrent builds (multi-process TCP ranks on one
-    # host) must not interleave writes before the atomic publish
-    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O2", "-g", "-std=c++17", "-fPIC", "-shared", "-pthread",
-           "-o", tmp, *srcs]
     try:
-        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
-    except (OSError, subprocess.TimeoutExpired) as e:
-        _build_error = f"g++ invocation failed: {e}"
+        return _compile(
+            _LIB_PATH, extra_flags=["-fsanitize=thread"] if _TSAN else ())
+    except RuntimeError as e:
+        _build_error = str(e)
         return None
-    if proc.returncode != 0:
-        _build_error = f"g++ failed:\n{proc.stderr[-2000:]}"
-        return None
-    os.replace(tmp, _LIB_PATH)
-    return _LIB_PATH
 
 
 def _load():
@@ -151,6 +178,8 @@ def _load():
         lib.pz_graph_fail.argtypes = [ctypes.c_void_p]
         lib.pz_graph_executed.restype = ctypes.c_int64
         lib.pz_graph_executed.argtypes = [ctypes.c_void_p]
+        lib.pz_graph_double_completes.restype = ctypes.c_int64
+        lib.pz_graph_double_completes.argtypes = [ctypes.c_void_p]
         lib.pz_graph_set_policy.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.pz_graph_steals.restype = ctypes.c_int64
         lib.pz_graph_steals.argtypes = [ctypes.c_void_p]
@@ -202,6 +231,23 @@ def missing_symbols() -> List[str]:
 
 def available() -> bool:
     return _load() is not None
+
+
+def tsan_suppressions_path() -> str:
+    """The shipped suppressions file for the TSan flavor (pass as
+    ``TSAN_OPTIONS=suppressions=<path>``)."""
+    return _TSAN_SUPP
+
+
+def build_tsan_library(timeout: int = 300) -> str:
+    """Compile the ThreadSanitizer flavor unconditionally (the CI smoke
+    leg: "the TSan build of the async engine still compiles").  Returns
+    the .so path; raises RuntimeError with the compiler output when the
+    toolchain lacks ``-fsanitize=thread`` or the sources fail under its
+    instrumentation.  Does NOT load the library into this process — a
+    TSan .so needs the sanitizer runtime preloaded."""
+    return _compile(os.path.join(_BUILD_DIR, "libparsec_core_tsan.so"),
+                    extra_flags=["-fsanitize=thread"], timeout=timeout)
 
 
 def build_error() -> Optional[str]:
@@ -269,6 +315,11 @@ class NativeGraph:
         is a Python callable entered through a ctypes trampoline.
     """
 
+    #: stable per-graph tokens for the hb site below — ``id(self)``
+    #: would be reused after GC and collide sequential graphs' task ids
+    #: in the checker's completion state (spurious RT005)
+    _HB_TOKENS = itertools.count(1)
+
     def __init__(self):
         lib = _load()
         if lib is None:
@@ -277,6 +328,7 @@ class NativeGraph:
         self._g = lib.pz_graph_new()
         self._n = 0
         self._keepalive: List = []
+        self.hb_token = next(NativeGraph._HB_TOKENS)
 
     def add_task(self, priority: int = 0, user_tag: int = 0) -> int:
         self._n += 1
@@ -407,6 +459,14 @@ class NativeGraph:
         rc = self._lib.pz_task_done(g, task_id)
         if rc == -1:
             raise ValueError(f"task_done: unknown task id {task_id}")
+        if pins.active(pins.NATIVE_TASK_DONE):
+            # happens-before site: one ASYNC completion entered the
+            # native engine.  accepted=False records a signal the
+            # double-complete guard refused — the hb checker flags two
+            # ACCEPTED completions for one task as RT005
+            pins.fire(pins.NATIVE_TASK_DONE, None,
+                      {"graph": self.hb_token, "task": int(task_id),
+                       "accepted": rc == 0})
         return rc == 0
 
     def fail(self) -> None:
@@ -429,6 +489,15 @@ class NativeGraph:
     @property
     def executed(self) -> int:
         return self._lib.pz_graph_executed(self._g)
+
+    @property
+    def double_completes(self) -> int:
+        """Signals the double-complete guard refused (0 on a healthy
+        run — the hb-check harness pins this; a nonzero value means a
+        completion path signalled one task twice and the atomic claim
+        saved the run)."""
+        g = self._g or getattr(self, "_closed_handle", None)
+        return self._lib.pz_graph_double_completes(g) if g else 0
 
     def close(self) -> None:
         """Detach: further run/task_done/fail calls no-op or raise.  The

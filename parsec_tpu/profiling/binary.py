@@ -192,7 +192,12 @@ class RankTraceSet:
              ("exec", "prepare_input", "complete_exec", "select",
               "dep_edge", "comm_send", "comm_recv", "comm_ctl",
               "comm_recv_eager", "comm_recv_rdv", "frame_coalesced",
-              "ce_send", "ce_recv", "qdepth", "steals")}
+              "ce_send", "ce_recv", "qdepth", "steals",
+              # happens-before event kinds (analysis.hb / tools hbcheck;
+              # TRACING.md "hb event kinds")
+              "hb_dep_dec", "hb_ver_bump", "hb_arena_alloc",
+              "hb_arena_recycle", "hb_frame_send", "hb_frame_deliver",
+              "hb_task_done", "sched_publish")}
             for t in self.traces]
         self._steals_seen: Dict[int, int] = {}
         self._subs: List[Any] = []
@@ -261,6 +266,19 @@ class RankTraceSet:
                 tr.instant(kid, src, self._tok(succ))
 
         sub(pins.RELEASE_DEPS_END, on_release)
+
+        def on_schedule(es, batch):
+            # scheduler hand-off instants: hbcheck's ordering edge for
+            # tasks released OUTSIDE release_deps (remote activations
+            # decrement counters directly) — event_id = task token
+            for t in batch or ():
+                r = self._es_rank(es, t)
+                tr = self._trace_of(r)
+                if tr is not None:
+                    tr.instant(self._k[r - self.base_rank]["sched_publish"],
+                               self._tok(t))
+
+        sub(pins.SCHEDULE_BEGIN, on_schedule)
 
         # scheduler-side subscribers: select latency + steal counts.
         # Empty selects (idle polls) are NOT logged: on a waiting mesh
@@ -360,6 +378,45 @@ class RankTraceSet:
         sub(pins.COMM_SEND_END, wire_cb("ce_send", "end"))
         sub(pins.COMM_RECV_BEGIN, wire_cb("ce_recv", "begin"))
         sub(pins.COMM_RECV_END, wire_cb("ce_recv", "end"))
+
+        # happens-before instants (tools hbcheck reconstructs the event
+        # streams offline — analysis.hb.analyze_trace).  Sites without a
+        # rank in the payload (dep counters, tile versions, arena slots)
+        # land on the set's FIRST trace; the native per-thread streams
+        # keep the event streams apart, which is what the checker orders
+        # on.  Ids are truncated to the record's 63-bit field.
+        def hb_cb(key, eid_fn, info_fn=lambda p: 0):
+            def cb(es, p):
+                tr = self._trace_of(p.get("rank", self.base_rank)) \
+                    if p else None
+                if tr is None:
+                    tr = self.traces[0]
+                tr.instant(self._k[tr.rank - self.base_rank][key],
+                           int(eid_fn(p)) & 0x7FFFFFFFFFFFFFFF,
+                           int(info_fn(p)))
+            return cb
+
+        def _hash(v) -> int:
+            return hash(v) & 0x7FFFFFFFFFFFFFFF
+
+        sub(pins.DEP_DECREMENT, hb_cb(
+            "hb_dep_dec", lambda p: _hash((p["tracker"], p["key"])),
+            lambda p: 1 if p["ready"] else 0))
+        sub(pins.DATA_VERSION_BUMP, hb_cb(
+            "hb_ver_bump", lambda p: p["data"],
+            lambda p: p.get("version", 0)))
+        sub(pins.ARENA_ALLOC, hb_cb("hb_arena_alloc", lambda p: p["slot"]))
+        sub(pins.ARENA_RECYCLE, hb_cb("hb_arena_recycle",
+                                      lambda p: p["slot"]))
+        sub(pins.HB_FRAME_SEND, hb_cb("hb_frame_send",
+                                      lambda p: p["frame"]))
+        sub(pins.HB_FRAME_DELIVER, hb_cb("hb_frame_deliver",
+                                         lambda p: p["frame"]))
+        sub(pins.NATIVE_TASK_DONE, hb_cb(
+            "hb_task_done",
+            lambda p: ((p["graph"] & 0x3FFFFF) << 40)
+            | (p["task"] & 0xFFFFFFFFFF),
+            lambda p: 1 if p["accepted"] else 0))
         return self
 
     def uninstall(self) -> None:

@@ -9,7 +9,8 @@ registered for the site (the reference gates with an enable mask,
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List
+import threading
+from typing import Any, Callable, Dict, Tuple
 
 # callback sites (reference PARSEC_PINS_FLAG enum)
 SELECT_BEGIN = "select_begin"
@@ -48,25 +49,55 @@ COMM_SEND_BEGIN = "comm_send_begin"
 COMM_SEND_END = "comm_send_end"
 COMM_RECV_BEGIN = "comm_recv_begin"
 COMM_RECV_END = "comm_recv_end"
+# happens-before sites (consumed by ``analysis.hb``, the runtime race
+# checker): the handful of runtime transitions whose ORDERING decides
+# concurrency correctness.  All fire with ``es=None`` and a dict payload;
+# producers guard payload construction behind ``active()`` so the hot
+# paths stay near-free when no checker is installed.
+DEP_DECREMENT = "dep_decrement"          # one dependency release observed
+                                         # {"tracker","key","ready","mode"}
+DATA_VERSION_BUMP = "data_version_bump"  # write retired: new tile version
+                                         # {"data","key","version","device"}
+ARENA_ALLOC = "arena_alloc"              # {"arena","slot"}
+ARENA_RECYCLE = "arena_recycle"          # {"arena","slot"}
+HB_FRAME_SEND = "hb_frame_send"          # {"rank","peer","frame"}
+HB_FRAME_DELIVER = "hb_frame_deliver"    # {"rank","peer","frame"}
+NATIVE_TASK_DONE = "native_task_done"    # {"graph","task","accepted"}
+# device-manager epilog entry, fired with the TASK as payload BEFORE its
+# outputs commit (version bumps): the hb checker needs the manager
+# thread's clock to join the task's exec before the bumps, or every
+# device-retired write looks unordered (COMPLETE_EXEC_BEGIN fires later,
+# after the bumps)
+DEVICE_EPILOG_BEGIN = "device_epilog_begin"
 
 ALL_SITES = [v for k, v in list(globals().items()) if k.isupper() and isinstance(v, str)]
 
-_subscribers: Dict[str, List[Callable[..., None]]] = {}
+#: site -> TUPLE of callbacks.  The value is immutable and replaced
+#: wholesale on every (un)subscribe — copy-on-write, so a concurrent
+#: ``fire`` iterating a snapshot can never observe a list mutating under
+#: it (subscribe/unsubscribe are legal from checker install/teardown
+#: while workers are firing).
+_subscribers: Dict[str, Tuple[Callable[..., None], ...]] = {}
 _enabled = False
+_sub_lock = threading.Lock()
 
 
 def subscribe(site: str, cb: Callable[..., None]) -> None:
     global _enabled
-    _subscribers.setdefault(site, []).append(cb)
-    _enabled = True
+    with _sub_lock:
+        _subscribers[site] = _subscribers.get(site, ()) + (cb,)
+        _enabled = True
 
 
 def unsubscribe(site: str, cb: Callable[..., None]) -> None:
     global _enabled
-    lst = _subscribers.get(site)
-    if lst and cb in lst:
-        lst.remove(cb)
-    _enabled = any(_subscribers.values())
+    with _sub_lock:
+        cur = _subscribers.get(site, ())
+        if cb in cur:
+            lst = list(cur)
+            lst.remove(cb)
+            _subscribers[site] = tuple(lst)
+        _enabled = any(_subscribers.values())
 
 
 def active(site: str) -> bool:
@@ -89,5 +120,6 @@ def fire(site: str, es: Any, payload: Any) -> None:
 
 def clear() -> None:
     global _enabled
-    _subscribers.clear()
-    _enabled = False
+    with _sub_lock:
+        _subscribers.clear()
+        _enabled = False

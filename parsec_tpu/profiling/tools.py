@@ -23,6 +23,11 @@ module is the equivalent over the framework's Chrome/Perfetto JSON traces:
   deadlock/liveness, expression/affinity lint — without executing a
   single task body.  Targets are ``.jdf`` files, ``module:callable``
   builders returning a PTG, or in-repo registry names (``--all``).
+* ``hbcheck`` — the RUNTIME half of the verifier
+  (:mod:`parsec_tpu.analysis.hb`): vector-clock happens-before race
+  detection over binary ``.pbt`` trace dumps — unordered conflicting
+  tile-version writes, arena double-recycles, late dependency releases,
+  double task completions, reported as stable ``RTxxx`` findings.
 
 Usage::
 
@@ -37,6 +42,7 @@ Usage::
     python -m parsec_tpu.profiling.tools lint \
         parsec_tpu.ops.cholesky:cholesky_ptg -D NT=4
     python -m parsec_tpu.profiling.tools lint --all
+    python -m parsec_tpu.profiling.tools hbcheck /tmp/tr/rank*.pbt
 """
 
 from __future__ import annotations
@@ -373,6 +379,32 @@ def cmd_lint(args) -> int:
     return 0
 
 
+def cmd_hbcheck(args) -> int:
+    """Happens-before race check over binary trace dump(s)
+    (see parsec_tpu.analysis.hb; live flavor: PARSEC_TPU_HBCHECK=1)."""
+    from ..analysis import errors_of
+    from ..analysis.hb import analyze_events, events_from_trace
+
+    events = events_from_trace(args.traces)
+    if not events:
+        print("hbcheck: no happens-before events in "
+              f"{args.traces} (record with a RankTraceSet, or set "
+              "PARSEC_TPU_HBCHECK=1 for the live checker)",
+              file=sys.stderr)
+        return 2
+    findings = analyze_events(events)
+    for f in findings:
+        print(f)
+    errs = len(errors_of(findings))
+    print(f"hbcheck: {len(events)} event(s), {errs} race(s), "
+          f"{len(findings) - errs} warning(s)")
+    if errs:
+        return 1
+    if args.strict and findings:
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="parsec_tpu.profiling.tools",
@@ -413,7 +445,7 @@ def main(argv=None) -> int:
     pl = sub.add_parser(
         "lint", help="ahead-of-time PTG/JDF graph verifier: edge "
         "reciprocity, data hazards, deadlock/liveness, expression lint "
-        "— no task body executes")
+        "— no task body executes (runtime counterpart: hbcheck)")
     pl.add_argument("targets", nargs="*",
                     help=".jdf file, module:callable returning a PTG, or "
                     "in-repo registry name")
@@ -429,6 +461,17 @@ def main(argv=None) -> int:
                     help="comma-separated finding codes to suppress "
                     "(e.g. PTG021 for dynamic-guard graphs)")
     pl.set_defaults(fn=cmd_lint)
+    ph = sub.add_parser(
+        "hbcheck", help="happens-before race check over binary .pbt "
+        "trace dumps: unordered tile-version writes, arena "
+        "double-recycles, late dep releases, double completions "
+        "(RTxxx findings; static counterpart: lint)")
+    ph.add_argument("traces", nargs="+",
+                    help=".pbt dumps (one per rank: rank0.pbt rank1.pbt "
+                    "... of one run)")
+    ph.add_argument("--strict", action="store_true",
+                    help="exit non-zero on warnings too, not just races")
+    ph.set_defaults(fn=cmd_hbcheck)
     args = p.parse_args(argv)
     return args.fn(args)
 

@@ -1,0 +1,356 @@
+"""hb-check — the vector-clock happens-before race detector.
+
+Three layers: the analyzer on synthetic event streams (exact edge
+semantics), the live PINS recorder on real runs (clean schedules stay
+clean; seeded races with a guard intentionally disabled are flagged,
+naming both events), and the post-hoc trace front-end (``tools
+hbcheck``)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu.analysis.hb import HBEvent, HBRecorder, analyze_events
+from parsec_tpu.profiling import pins
+
+
+def _ev(seq, thread, kind, obj, info=None):
+    return HBEvent(seq, thread, kind, obj, info)
+
+
+# ---------------------------------------------------------------------------
+# analyzer semantics (synthetic streams)
+# ---------------------------------------------------------------------------
+
+def test_unordered_version_bumps_flag_rt001():
+    fs = analyze_events([
+        _ev(1, "A", "ver_bump", ("data", 5), {"version": 1}),
+        _ev(2, "B", "ver_bump", ("data", 5), {"version": 2}),
+    ])
+    assert [f.code for f in fs] == ["RT001"]
+    # both offending events are named
+    assert "ver_bump[A]#1" in fs[0].message
+    assert "ver_bump[B]#2" in fs[0].message
+
+
+def test_dep_edge_plus_exec_orders_the_writers():
+    """producer bumps, releases successor (dep_edge), successor's
+    exec_begin joins, successor bumps: ordered, no finding."""
+    fs = analyze_events([
+        _ev(1, "A", "ver_bump", ("data", 5), {"version": 1}),
+        _ev(2, "A", "dep_edge", (10, 11)),
+        _ev(3, "B", "exec_begin", 11),
+        _ev(4, "B", "ver_bump", ("data", 5), {"version": 2}),
+    ])
+    assert fs == []
+
+
+def test_task_publish_orders_like_dep_edge():
+    """Remote activations decrement counters directly (no RELEASE_DEPS):
+    the scheduler hand-off instant carries the edge instead."""
+    fs = analyze_events([
+        _ev(1, "A", "ver_bump", ("data", 5), {"version": 1}),
+        _ev(2, "A", "task_publish", 11),
+        _ev(3, "B", "exec_begin", 11),
+        _ev(4, "B", "ver_bump", ("data", 5), {"version": 2}),
+    ])
+    assert fs == []
+
+
+def test_frame_send_deliver_orders_across_ranks():
+    fs = analyze_events([
+        _ev(1, "r0", "ver_bump", ("data", 5), {"version": 1}),
+        _ev(2, "r0", "frame_send", 42),
+        _ev(3, "r1", "frame_deliver", 42),
+        _ev(4, "r1", "ver_bump", ("data", 5), {"version": 2}),
+    ])
+    assert fs == []
+
+
+def test_exec_to_complete_handoff_orders_manager_thread():
+    """A device manager completing a task it did not execute joins the
+    worker's exec clock at complete_begin (or the earlier device-epilog
+    join)."""
+    fs = analyze_events([
+        _ev(1, "W", "ver_bump", ("data", 1), {"version": 1}),
+        _ev(2, "W", "exec_end", 7),
+        _ev(3, "M", "complete_begin", 7),
+        _ev(4, "M", "ver_bump", ("data", 1), {"version": 2}),
+    ])
+    assert fs == []
+
+
+def test_deliver_without_send_warns_rt004():
+    fs = analyze_events([
+        _ev(1, "r0", "frame_send", 1),
+        _ev(2, "r1", "frame_deliver", 1),
+        _ev(3, "r1", "frame_deliver", 99),  # never sent
+    ])
+    assert [f.code for f in fs] == ["RT004"]
+    assert not fs[0].is_error
+
+
+def test_dep_decrement_chain_carries_all_producers():
+    """Two producers release one counter from different threads: the
+    firing decrement joins the first's clock, so the successor is
+    ordered after BOTH writers."""
+    fs = analyze_events([
+        _ev(1, "A", "ver_bump", ("data", 1), {"version": 1}),
+        _ev(2, "A", "dep_dec", ("t", ("c", (0,))), {"ready": False}),
+        _ev(3, "B", "ver_bump", ("data", 2), {"version": 1}),
+        _ev(4, "B", "dep_dec", ("t", ("c", (0,))), {"ready": True}),
+        _ev(5, "B", "dep_edge", (20, 21)),
+        _ev(6, "C", "exec_begin", 21),
+        _ev(7, "C", "ver_bump", ("data", 1), {"version": 2}),
+        _ev(8, "C", "ver_bump", ("data", 2), {"version": 2}),
+    ])
+    assert fs == []
+
+
+def test_release_after_fire_flags_rt003():
+    fs = analyze_events([
+        _ev(1, "A", "dep_dec", ("t", ("c", (0,))), {"ready": True}),
+        _ev(2, "B", "dep_dec", ("t", ("c", (0,))), {"ready": False}),
+    ])
+    assert [f.code for f in fs] == ["RT003"]
+
+
+# ---------------------------------------------------------------------------
+# live recorder on real runtime objects
+# ---------------------------------------------------------------------------
+
+def test_live_clean_single_rank_cholesky():
+    from parsec_tpu import Context
+    from parsec_tpu.datadist.matrix import TiledMatrix
+    from parsec_tpu.ops.cholesky import cholesky_ptg
+
+    rng = np.random.default_rng(0)
+    N, nb = 32, 8
+    M = rng.standard_normal((N, N))
+    SPD = M @ M.T + N * np.eye(N)
+    with HBRecorder() as rec:
+        ctx = Context(nb_cores=4)
+        A = TiledMatrix(N, N, nb, nb)
+        A.from_array(SPD)
+        tp = cholesky_ptg(use_tpu=False).taskpool(NT=A.mt, A=A)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=60)
+        ctx.fini()
+    assert rec.analyze() == []
+    assert len(rec.events) > 0
+
+
+def test_same_named_threads_keep_distinct_clocks():
+    """Every in-process Context names its workers parsec-worker-<i>: two
+    ranks' same-named threads must NOT merge into one vector clock, or
+    cross-context races become invisible (code-review fix)."""
+    from parsec_tpu.data.data import data_create
+
+    d = data_create("k", payload=np.zeros(2))
+    d.attach_copy(1, np.zeros(2))
+    bar = threading.Barrier(2)
+
+    def bump(dev):
+        bar.wait()  # both threads live at once, like two ranks' workers
+        d.version_bump(dev)
+
+    with HBRecorder() as rec:
+        ts = [threading.Thread(target=bump, args=(dev,),
+                               name="parsec-worker-0")  # SAME name
+              for dev in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    assert [f.code for f in rec.analyze()] == ["RT001"]
+
+
+def test_real_dep_tracker_fires_hb_events():
+    from parsec_tpu.core.deps import DepTracker
+
+    t = DepTracker()
+    with HBRecorder() as rec:
+        assert t.release_counter(("a", (0,)), 2) == (False, None)
+        assert t.release_counter(("a", (0,)), 2)[0] is True
+    kinds = [e.kind for e in rec.events]
+    assert kinds == ["dep_dec", "dep_dec"]
+    assert rec.analyze() == []
+
+
+def test_duplicate_release_after_fire_detected_live():
+    """The runtime signature of a duplicate dependency edge: a third
+    release of an already-fired counter."""
+    from parsec_tpu.core.deps import DepTracker
+
+    t = DepTracker()
+    with HBRecorder() as rec:
+        t.release_counter(("a", (0,)), 2)
+        t.release_counter(("a", (0,)), 2)   # fires
+        t.release_counter(("a", (0,)), 2)   # duplicate: after the fire
+    assert [f.code for f in rec.analyze()] == ["RT003"]
+
+
+# ---------------------------------------------------------------------------
+# seeded races: guards intentionally disabled (the acceptance fixtures)
+# ---------------------------------------------------------------------------
+
+def test_task_done_double_complete_guard_disabled_flags_rt005():
+    """A guard-less native engine would run the release pass twice: the
+    fixture simulates pz_task_done WITHOUT the atomic claim by reporting
+    both signals accepted — hb-check names both events."""
+    with HBRecorder() as rec:
+        for _ in range(2):  # what a guard-less pz_task_done would emit
+            pins.fire(pins.NATIVE_TASK_DONE, None,
+                      {"graph": 1, "task": 7, "accepted": True})
+    fs = rec.analyze()
+    assert [f.code for f in fs] == ["RT005"]
+    # both offending events are named (thread identity = name#ident)
+    assert fs[0].message.count("task_done[MainThread") == 2
+
+
+def test_task_done_guard_intact_is_clean():
+    """The real engine: the second signal is REJECTED by the atomic
+    claim (accepted=False) and hb-check stays clean."""
+    native = pytest.importorskip("parsec_tpu.native")
+    if not native.available():
+        pytest.skip(f"native core unavailable: {native.build_error()}")
+    g = native.NativeGraph()
+    t0 = g.add_task()
+    g.commit(t0)
+    g.seal()
+    done = []
+    with HBRecorder() as rec:
+        def body(task_id, tag):
+            done.append(task_id)
+            return True  # ASYNC
+
+        ran = threading.Event()
+
+        def complete():
+            while not done:
+                pass
+            assert g.task_done(t0) is True
+            assert g.task_done(t0) is False  # guard: rejected
+            ran.set()
+
+        th = threading.Thread(target=complete)
+        th.start()
+        g.run_async(body, nthreads=2)
+        th.join(timeout=10)
+        assert ran.is_set()
+    fs = rec.analyze()
+    assert fs == []
+    kinds = [e.info for e in rec.events if e.kind == "task_done"]
+    assert [k["accepted"] for k in kinds] == [True, False]
+    # the native guard's own telemetry counted exactly the refusal
+    assert g.double_completes == 1
+
+
+def test_arena_recycle_guard_disabled_flags_rt002():
+    from parsec_tpu.data.arena import Arena
+
+    ar = Arena((8,), np.float64, name="fixture")
+    with HBRecorder() as rec:
+        c = ar.allocate()
+        ar._recycle(c)   # guard intentionally bypassed
+        ar._recycle(c)   # the double recycle the guard would refuse
+    fs = rec.analyze()
+    assert [f.code for f in fs] == ["RT002"]
+    assert "arena_recycle" in fs[0].message
+    # both events named, with call sites
+    assert fs[0].message.count("arena_recycle[") == 2
+
+
+def test_arena_alloc_between_recycles_is_clean():
+    from parsec_tpu.data.arena import Arena
+
+    ar = Arena((8,), np.float64, name="cycle")
+    with HBRecorder() as rec:
+        for _ in range(3):
+            c = ar.allocate()
+            ar.release(c)
+    assert rec.analyze() == []
+
+
+# ---------------------------------------------------------------------------
+# post-hoc front-end (tools hbcheck over .pbt dumps)
+# ---------------------------------------------------------------------------
+
+def _native_or_skip():
+    from parsec_tpu import native
+
+    if not native.available():
+        pytest.skip(f"native core unavailable: {native.build_error()}")
+
+
+def test_hbcheck_cli_on_recorded_trace(tmp_path, capsys):
+    _native_or_skip()
+    from parsec_tpu import Context
+    from parsec_tpu.datadist.matrix import TiledMatrix
+    from parsec_tpu.ops.cholesky import cholesky_ptg
+    from parsec_tpu.profiling.binary import RankTraceSet
+    from parsec_tpu.profiling.tools import main as tools_main
+
+    rng = np.random.default_rng(1)
+    N, nb = 32, 8
+    M = rng.standard_normal((N, N))
+    SPD = M @ M.T + N * np.eye(N)
+    traces = RankTraceSet(1).install()
+    try:
+        ctx = Context(nb_cores=2)
+        A = TiledMatrix(N, N, nb, nb)
+        A.from_array(SPD)
+        tp = cholesky_ptg(use_tpu=False).taskpool(NT=A.mt, A=A)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=60)
+        ctx.fini()
+        paths = traces.dump(str(tmp_path))
+    finally:
+        traces.uninstall()
+        traces.close()
+    rc = tools_main(["hbcheck", *paths])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "0 race(s)" in out
+
+
+def test_hbcheck_cli_flags_doctored_trace(tmp_path, capsys):
+    """A trace carrying two unordered version commits for one tile (two
+    threads, no hb events between) exits non-zero with RT001."""
+    _native_or_skip()
+    from parsec_tpu.profiling.binary import BinaryTrace
+    from parsec_tpu.profiling.tools import main as tools_main
+
+    tr = BinaryTrace(rank=0)
+    kid = tr.keyword("hb_ver_bump")
+
+    def bump(version):
+        tr.instant(kid, 5, version)
+
+    t = threading.Thread(target=bump, args=(1,), name="writer-a")
+    t.start()
+    t.join()
+    t = threading.Thread(target=bump, args=(2,), name="writer-b")
+    t.start()
+    t.join()
+    p = str(tmp_path / "doctored.pbt")
+    tr.dump(p)
+    tr.close()
+    rc = tools_main(["hbcheck", p])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "RT001" in out
+
+
+def test_hbcheck_cli_no_events_exits_2(tmp_path, capsys):
+    _native_or_skip()
+    from parsec_tpu.profiling.binary import BinaryTrace
+    from parsec_tpu.profiling.tools import main as tools_main
+
+    tr = BinaryTrace(rank=0)
+    tr.instant(tr.keyword("unrelated"), 1)
+    p = str(tmp_path / "empty.pbt")
+    tr.dump(p)
+    tr.close()
+    assert tools_main(["hbcheck", p]) == 2

@@ -1,0 +1,65 @@
+"""Arena/BytePool double-recycle guard: the second release of one slot
+raises a readable error instead of silently pushing the buffer onto the
+free list twice (two future allocations would alias one buffer)."""
+
+import gc
+import weakref
+
+import numpy as np
+import pytest
+
+from parsec_tpu.data.arena import Arena, ArenaRecycleError, BytePool
+
+
+def test_double_release_raises_readable_error():
+    ar = Arena((8,), np.float64, name="guarded")
+    c = ar.allocate()
+    ar.release(c)
+    with pytest.raises(ArenaRecycleError, match="guarded.*recycled twice"):
+        ar.release(c)
+    # the free list holds the buffer exactly ONCE
+    assert ar.stats()["cached"] == 1
+    assert ar.stats()["used"] == 0
+
+
+def test_free_list_never_aliases_after_refused_double_release():
+    ar = Arena((4,), np.float64, name="alias")
+    c = ar.allocate()
+    ar.release(c)
+    with pytest.raises(ArenaRecycleError):
+        ar.release(c)
+    # had the second release gone through, these two allocations would
+    # share one buffer
+    c1, c2 = ar.allocate(), ar.allocate()
+    c1.payload[:] = 1.0
+    c2.payload[:] = 2.0
+    assert c1.payload[0] == 1.0 and c2.payload[0] == 2.0
+
+
+def test_normal_recycle_cycle_unaffected():
+    ar = Arena((4,), np.float64, name="cycle")
+    for _ in range(5):
+        c = ar.allocate()
+        ar.release(c)
+    st = ar.stats()
+    assert st["used"] == 0
+    assert st["created"] == 1  # one buffer, recycled five times
+
+
+def test_finalizer_racing_explicit_release_is_refused():
+    """The _RdvPull/TCP-rx shape: a weakref finalizer releases the slot
+    when the last consumer dies.  If the slot was ALSO released
+    explicitly, the finalizer's release must be refused loudly, not
+    corrupt the free list."""
+    pool = BytePool("rx")
+    slot = pool.allocate(1024)
+    holder = slot.payload[:100]
+    fin = weakref.finalize(holder, slot.arena.release, slot)
+    slot.arena.release(slot)  # explicit release wins the race
+    with pytest.raises(ArenaRecycleError):
+        fin()  # the finalizer's release is refused, not silent corruption
+    del holder
+    gc.collect()
+    ar = pool.arenas()[0]
+    assert ar.stats()["cached"] == 1  # slot in the free list exactly once
+    assert ar.stats()["used"] == 0

@@ -33,6 +33,13 @@ except Exception:
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 run (-m 'not slow') — e.g. the "
+        "200-seed schedule-explorer sweep")
+
+
 @pytest.fixture(autouse=True)
 def _quiet_debug():
     from parsec_tpu.utils import debug

@@ -1,0 +1,94 @@
+"""PINS subscription list is copy-on-write: (un)subscribe during a
+concurrent ``fire`` must never mutate the callback sequence an in-flight
+fire is iterating."""
+
+import threading
+
+from parsec_tpu.profiling import pins
+
+SITE = pins.EXEC_BEGIN
+
+
+def test_subscribe_during_fire_threaded_stress():
+    stop = threading.Event()
+    fired = [0]
+    errors = []
+
+    def keeper(es, payload):
+        fired[0] += 1
+
+    pins.subscribe(SITE, keeper)
+
+    def firehose():
+        while not stop.is_set():
+            pins.fire(SITE, None, None)
+
+    def churn(tid):
+        def cb(es, payload):
+            pass
+
+        try:
+            for _ in range(2000):
+                pins.subscribe(SITE, cb)
+                pins.unsubscribe(SITE, cb)
+        except Exception as e:  # pragma: no cover - the failure signal
+            errors.append(e)
+
+    try:
+        fire_threads = [threading.Thread(target=firehose) for _ in range(2)]
+        churners = [threading.Thread(target=churn, args=(i,))
+                    for i in range(4)]
+        for t in fire_threads + churners:
+            t.start()
+        for t in churners:
+            t.join(timeout=60)
+        stop.set()
+        for t in fire_threads:
+            t.join(timeout=10)
+    finally:
+        stop.set()
+        pins.unsubscribe(SITE, keeper)
+    assert errors == []
+    assert fired[0] > 0
+    # the permanent subscriber survived the churn, transients are gone
+    assert not pins.active(SITE)
+
+
+def test_unsubscribe_self_during_fire_is_safe():
+    """A callback removing ITSELF mid-fire: the snapshot the fire holds
+    still completes (every callback of the snapshot runs once)."""
+    calls = []
+
+    def a(es, p):
+        calls.append("a")
+        pins.unsubscribe(SITE, a)
+
+    def b(es, p):
+        calls.append("b")
+
+    pins.subscribe(SITE, a)
+    pins.subscribe(SITE, b)
+    try:
+        pins.fire(SITE, None, None)
+        assert calls == ["a", "b"]
+        pins.fire(SITE, None, None)   # a removed itself: only b now
+        assert calls == ["a", "b", "b"]
+    finally:
+        pins.unsubscribe(SITE, b)
+        pins.unsubscribe(SITE, a)
+
+
+def test_subscribers_are_immutable_snapshots():
+    def a(es, p):
+        pass
+
+    pins.subscribe(SITE, a)
+    try:
+        snap = pins._subscribers[SITE]
+        assert isinstance(snap, tuple)  # COW: replaced, never mutated
+        pins.subscribe(SITE, a)
+        assert pins._subscribers[SITE] is not snap
+    finally:
+        pins.unsubscribe(SITE, a)
+        pins.unsubscribe(SITE, a)
+    assert not pins.active(SITE)

@@ -1,0 +1,86 @@
+"""Four-counter termination detection under the schedule explorer's
+delayed/reordered frame delivery: quiescence is NEVER declared while an
+application frame is in flight — a deferred frame is counted as sent but
+not yet received, so the wave totals cannot balance until it lands."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.analysis.schedules import ExplorerFabric
+from parsec_tpu.comm.engine import TAG_TERMDET
+from parsec_tpu.comm.termdet_fourcounter import TermDetFourCounter
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fourcounter_never_declares_with_app_frame_in_flight(
+        monkeypatch, seed):
+    nranks, n = 2, 8
+    # aggressive perturbation: most frames deferred, deeply
+    fabric = ExplorerFabric(nranks, seed, delay_prob=0.7, max_delay=5)
+    ces = fabric.endpoints()
+    violations = []
+    declared = []
+    orig_declare = TermDetFourCounter._declare
+
+    def checked_declare(self):
+        # AT the declaration instant: every frame still held by the
+        # perturbed inboxes must be pure termdet traffic (the terminate
+        # broadcast itself may be in flight); any app tag here means
+        # quiescence was declared with an application frame in flight
+        for r, inbox in enumerate(fabric.inboxes):
+            for frame in inbox.peek_pending():
+                _src, batch, _pb, _fid = frame
+                tags = [t for t, _p in batch]
+                if any(t != TAG_TERMDET for t in tags):
+                    violations.append((r, tags))
+        # the four counters must balance globally: sent == recv over the
+        # app traffic both endpoints of every frame already counted
+        sent = sum(ce.termdet_sent for ce in ces)
+        recv = sum(ce.termdet_recv for ce in ces)
+        if sent != recv:
+            violations.append(("unbalanced", sent, recv))
+        declared.append(self)
+        return orig_declare(self)
+
+    monkeypatch.setattr(TermDetFourCounter, "_declare", checked_declare)
+
+    from parsec_tpu.data import LocalCollection
+    from parsec_tpu.dsl.ptg import PTG, INOUT
+
+    ctxs = [Context(nb_cores=2, rank=r, nranks=nranks, comm=ces[r])
+            for r in range(nranks)]
+    oks = [None] * nranks
+
+    def worker(r):
+        dc = LocalCollection("D", shape=(4,), nodes=nranks, myrank=r,
+                             init=lambda k: np.zeros(4))
+        dc.rank_of = lambda *key: dc.data_key(*key) % nranks
+        ptg = PTG("fcexp")
+        step = ptg.task_class("step", k=f"0 .. {n - 1}")
+        step.affinity("D(k)")
+        step.flow("X", INOUT,
+                  "<- (k == 0) ? D(0) : X step(k-1)",
+                  f"-> (k < {n - 1}) ? X step(k+1) : D(k)")
+        step.body(cpu=lambda X, k: X.__iadd__(1.0))
+        tp = ptg.taskpool(termdet="fourcounter", D=dc)
+        ctxs[r].add_taskpool(tp)
+        oks[r] = tp.wait(timeout=90)
+
+    try:
+        ts = [threading.Thread(target=worker, args=(r,))
+              for r in range(nranks)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert all(oks), oks
+        assert declared, "termination never declared"
+        assert violations == [], (
+            "quiescence declared with application frame(s) in flight: "
+            f"{violations}")
+    finally:
+        for c in ctxs:
+            c.fini()
