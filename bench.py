@@ -501,6 +501,15 @@ def _rest_of_main(N, NB, dtype, backend, on_accel, reps, rtt,
     if os.environ.get("BENCH_WIRE", "1") != "0":
         _leg(fields, "comm_wire", lambda: comm_wire_leg(fields))
 
+    # ---- STAGE 3d: observability overhead (round-8 health plane) -------
+    # tasks/s A/B on a CPU-body dpotrf with the serving-side health plane
+    # (HTTP exporter under live scrape + always-on flight recorder +
+    # watchdog) ON vs OFF; the <3% pin guards the "always-on in
+    # production" claim (PARSEC_TPU_PERF_ASSERTS=0 to skip the assert).
+    if os.environ.get("BENCH_OBS", "1") != "0":
+        _leg(fields, "observability_overhead",
+             lambda: observability_overhead_leg(fields))
+
     # ---- STAGE 4: QR / LU through the runtime --------------------------
     if on_accel and os.environ.get("BENCH_QRLU", "1") != "0" \
             and not _over_budget(0.80, "qr/lu stage"):
@@ -577,6 +586,99 @@ def comm_wire_leg(fields: dict) -> None:
             t.start()
         for t in ts:
             t.join()
+
+
+def observability_overhead_leg(fields: dict) -> None:
+    """A/B the health plane's always-on cost: tasks/s of the dpotrf
+    dynamic leg (device bodies through the runtime — the production
+    serving path) with nothing installed vs with the full serving
+    stack: flight recorder (bounded ring on the PINS sites), HTTP
+    exporter under a live 1 Hz scrape (Prometheus's default interval is
+    15 s; 1 Hz is already aggressive), and a stall watchdog.
+    Interleaved off/on pairs so host drift hits both arms equally."""
+    import threading as _th
+    import urllib.request
+
+    from parsec_tpu import Context
+    from parsec_tpu.datadist import TiledMatrix
+    from parsec_tpu.ops.cholesky import cholesky_ptg
+
+    n, nb = 2048, 128
+    ntasks = _dpotrf_ntasks(n, nb)
+    rng = np.random.default_rng(11)
+    M = rng.standard_normal((n, n))
+    SPD = M @ M.T + n * np.eye(n)
+
+    def one_run(obs: bool) -> float:
+        """One factorization to quiescence; returns tasks/s."""
+        from parsec_tpu.profiling.flight import FlightRecorder
+        from parsec_tpu.profiling.health import HealthServer, Watchdog
+
+        ctx = Context(nb_cores=4)
+        fr = hs = wd = None
+        stop_scrape = _th.Event()
+        scraper = None
+        try:
+            if obs:
+                fr = FlightRecorder(nranks=1).install()
+                hs = HealthServer(ctx).start()
+                wd = Watchdog(ctx, window=120.0).start()
+                ctx.watchdog = wd
+                url = hs.url + "/metrics"
+
+                def scrape():
+                    while not stop_scrape.wait(1.0):
+                        try:
+                            urllib.request.urlopen(url, timeout=5).read()
+                        except OSError:
+                            pass
+
+                scraper = _th.Thread(target=scrape, daemon=True)
+                scraper.start()
+            A = TiledMatrix(n, n, nb, nb, name="A").from_array(SPD)
+            tp = cholesky_ptg().taskpool(NT=A.mt, A=A)
+            t0 = time.perf_counter()
+            ctx.add_taskpool(tp)
+            if not tp.wait(timeout=300):
+                raise RuntimeError("observability A/B run did not quiesce")
+            dt = time.perf_counter() - t0
+            return ntasks / dt
+        finally:
+            stop_scrape.set()
+            if scraper is not None:
+                scraper.join(timeout=5)
+            if wd is not None:
+                wd.stop()
+            if hs is not None:
+                hs.stop()
+            if fr is not None:
+                fr.uninstall()
+            ctx.fini()
+
+    reps = int(os.environ.get("BENCH_OBS_REPS", "5"))
+    one_run(False)  # warm the numpy/runtime paths out of the measurement
+    off, on = [], []
+    for _ in range(reps):
+        off.append(one_run(False))
+        on.append(one_run(True))
+    off.sort(), on.sort()
+    # overhead is quoted BEST vs BEST: on a shared host the wall-clock
+    # spread dwarfs the effect (this box measured an 80% base spread),
+    # and best-of-reps is the classic low-noise estimator for a paired
+    # A/B — medians are recorded alongside for the spread
+    t_off, t_on = off[-1], on[-1]
+    overhead = max(0.0, 1.0 - t_on / t_off)
+    fields["obs_tasks_per_s_off"] = round(t_off, 1)
+    fields["obs_tasks_per_s_on"] = round(t_on, 1)
+    fields["obs_tasks_per_s_off_med"] = round(off[len(off) // 2], 1)
+    fields["obs_tasks_per_s_on_med"] = round(on[len(on) // 2], 1)
+    fields["obs_ntasks"] = ntasks
+    fields["obs_overhead_frac"] = round(overhead, 4)
+    if os.environ.get("PARSEC_TPU_PERF_ASSERTS", "1") != "0" \
+            and overhead >= 0.03:
+        raise AssertionError(
+            f"observability overhead {overhead:.1%} >= 3% "
+            f"({t_off:.0f} -> {t_on:.0f} tasks/s)")
 
 
 def panel_stage(n: int, nb: int, rtt: float, fields: dict) -> None:

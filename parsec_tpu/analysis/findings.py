@@ -74,6 +74,30 @@ CODES = {
     "RT010": (ERROR, "inconsistent lock acquisition order between two "
                      "lock sites (A->B and B->A both observed: potential "
                      "deadlock)"),
+    # OBS0xx: OBSERVABILITY findings (profiling.health watchdog) — the
+    # structured hang diagnosis a stalled mesh emits instead of a silent
+    # timeout.  Same append-only contract as PTGxxx/RTxxx.
+    "OBS001": (ERROR, "stalled run: no progress epoch advance (tasks "
+                      "retired, frames delivered, termdet transitions) "
+                      "within the watchdog window while a taskpool is "
+                      "non-terminated"),
+    "OBS002": (ERROR, "dependency counters pending at stall: a task was "
+                      "released by only a strict subset of its producers "
+                      "(the runtime signature of the asymmetric-deps "
+                      "defects ptg-lint flags as PTG001/PTG002)"),
+    "OBS003": (WARNING, "rendezvous pulls still in flight at stall: "
+                        "payload chunks were requested but never landed "
+                        "(lost GET answer, or a wedged peer)"),
+    "OBS004": (WARNING, "silent rank: no heartbeat heard from a peer "
+                        "within the watchdog window (dead process, or a "
+                        "wedged delivery path toward this rank)"),
+    "OBS005": (WARNING, "distributed termination detection cannot "
+                        "conclude: the piggybacked picture stays busy or "
+                        "the sent/recv totals never balance (a message "
+                        "is counted in flight forever)"),
+    "OBS006": (WARNING, "ready tasks queued but none retiring: the "
+                        "scheduler backlog is frozen (workers wedged, or "
+                        "every ready task blocked inside its body)"),
 }
 
 

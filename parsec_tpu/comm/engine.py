@@ -138,6 +138,41 @@ class CommEngine(Component):
         never overtaken."""
         raise NotImplementedError
 
+    def register_ctl(self, op: str, cb: Callable[[int, Any], None]) -> None:
+        """Share the single generic-control tag among independent
+        protocols: ``TAG_CTL`` frames are dicts carrying an ``"op"`` key,
+        and this registers ``cb(src_rank, msg)`` for one op.  The first
+        call installs a dispatching AM handler that persists for the
+        engine's lifetime; later registrations (clock handshakes at every
+        pool start, a watchdog's heartbeat channel) replace only their own
+        op — they can no longer silently unhook each other the way raw
+        ``register_am(TAG_CTL, ...)`` calls did."""
+        with CommEngine._ctl_install_lock:
+            # first-install must be atomic: two threads racing here
+            # (concurrent pool starts each running a clock handshake)
+            # would otherwise build two dispatchers and the loser's ops
+            # would be silently unhooked
+            ops = getattr(self, "_ctl_ops", None)
+            if ops is None:
+                ops = self._ctl_ops = {}
+
+                def _dispatch(src_rank: int, msg: Any) -> None:
+                    fn = ops.get(msg.get("op")) \
+                        if isinstance(msg, dict) else None
+                    if fn is None:
+                        debug.verbose(
+                            3, "comm", "unhandled CTL op %r from %d",
+                            msg.get("op") if isinstance(msg, dict)
+                            else msg, src_rank)
+                        return
+                    fn(src_rank, msg)
+
+                self.register_am(TAG_CTL, _dispatch)
+            ops[op] = cb
+
+    #: guards the one-time _ctl_ops installation above
+    _ctl_install_lock = threading.Lock()
+
     @contextlib.contextmanager
     def coalesce(self):
         """Coalescing window: messages sent inside nest into per-

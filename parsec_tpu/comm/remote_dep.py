@@ -202,6 +202,7 @@ class _RdvPull:
                     self.finished = True
                     finish = "done"
         if finish == "fail":
+            self.mgr.stats["rdv_pulls_failed"] += 1
             # best-effort release: this consumer will never send its fin
             # chunk, so consume our use of the registration with a
             # zero-length fin read — otherwise the producer's use count
@@ -222,6 +223,7 @@ class _RdvPull:
                        "bytes": ln, "kind": "rdv", "proto": "rdv",
                        "chunk": idx, "nchunks": self.nchunks})
         if finish == "done":
+            self.mgr.stats["rdv_pulls_done"] += 1
             self.cb(from_wire(self.desc["hdr"], self.holder))
             return
         self.pump()
@@ -334,6 +336,14 @@ class RemoteDepManager:
             "rdv_bytes": int(self.stats["rdv_bytes"]),
             "rdv_chunks": int(self.stats["rdv_chunks_req"]),
         }
+
+    def rdv_pulls_in_flight(self) -> int:
+        """Incoming rendezvous transfers started but not yet fully landed
+        (nor failed) — a live gauge for the health plane: nonzero at
+        quiescence means payload chunks went missing."""
+        return max(0, int(self.stats["rdv_pulls"])
+                   - int(self.stats["rdv_pulls_done"])
+                   - int(self.stats["rdv_pulls_failed"]))
 
     # -- taskpool registry ----------------------------------------------
     def new_taskpool(self, tp) -> None:

@@ -28,7 +28,10 @@ class CompoundTaskpool(Taskpool):
         return self
 
     def attached(self, context) -> None:
-        self.context = context
+        # base attach does the bookkeeping (context, progress baseline;
+        # the _known_nb_tasks branch is a no-op here — the member count
+        # was set in __init__), then the first member launches
+        super().attached(context)
         self._launch_next()
 
     def startup(self, context):
@@ -44,7 +47,11 @@ class CompoundTaskpool(Taskpool):
         def chain(tp, _prev=prev_cb):
             if _prev is not None:
                 _prev(tp)
-            self.tdm.taskpool_addto_nb_tasks(self, -1)
+            # retire through task_done (not a bare tdm decrement): the
+            # health plane's progress()/watchdog read nb_retired, and a
+            # compound that never counts retirements reads as "0/N tasks
+            # retired, never released" in a stall diagnosis
+            self.task_done()
             self._launch_next()
 
         member.on_complete = chain

@@ -152,6 +152,37 @@ class Context:
                       [d.name for d in self.devices])
         if self.comm is not None:
             self.comm.attach_context(self)
+        # opt-in health plane (installed LAST: the watchdog's heartbeat
+        # channel and the exporter's comm gauges need the attached comm
+        # engine).  PARSEC_TPU_FLIGHT=1 — always-on bounded flight
+        # recorder (rank-routed ring of trace events, dumped on body
+        # failure / watchdog firing / "tools flightdump");
+        # PARSEC_TPU_HEALTH=1|<port> — HTTP exporter serving /metrics,
+        # /status, /healthz, /flightdump (a numeric port is offset by
+        # rank so in-process meshes don't collide);
+        # PARSEC_TPU_WATCHDOG=1|strict — stall watchdog (strict fails
+        # stalled pools with the OBS diagnosis instead of hanging).
+        self.flight = None
+        self.health = None
+        self.watchdog = None
+        fl = os.environ.get("PARSEC_TPU_FLIGHT", "0")
+        if fl not in ("", "0"):
+            from ..profiling.flight import FlightRecorder
+
+            self.flight = FlightRecorder(
+                nranks=1, base_rank=self.rank).install()
+        hp = os.environ.get("PARSEC_TPU_HEALTH", "")
+        if hp not in ("", "0"):
+            from ..profiling.health import HealthServer
+
+            port = int(hp) + self.rank if hp.isdigit() and hp != "1" else 0
+            self.health = HealthServer(self, port=port).start()
+        wd = os.environ.get("PARSEC_TPU_WATCHDOG", "0")
+        if wd not in ("", "0"):
+            from ..profiling.health import Watchdog
+
+            self.watchdog = Watchdog(
+                self, strict=(wd.strip().lower() == "strict")).start()
 
     # ------------------------------------------------------------------
     # taskpool lifecycle
@@ -403,6 +434,12 @@ class Context:
                 rd._fail_pool_everywhere(task.taskpool, why)
             else:
                 _fail_pool(task.taskpool, why)
+            # incident artifacts: snapshot the flight recorder(s) so the
+            # failure ships with the last N runtime events per rank
+            # (no-op unless PARSEC_TPU_FLIGHT installed one; never raises)
+            from ..profiling import flight as _flight
+
+            _flight.dump_on_failure(why)
             # do NOT run the completion side: release_deps would forward
             # the failed task's stale payloads to REMOTE successors (and
             # write stale data back to remote home tiles) — healthy peer
@@ -470,6 +507,21 @@ class Context:
 
     def fini(self) -> None:
         """Reference ``parsec_fini``: drain and tear down."""
+        # health plane first: the watchdog must not diagnose the
+        # teardown as a stall, and the exporter must stop serving a
+        # context whose structures are being dismantled
+        for attr in ("watchdog", "health"):
+            obj = getattr(self, attr, None)
+            if obj is not None:
+                try:
+                    obj.stop()
+                except Exception as e:
+                    debug.warning("%s stop failed: %s", attr, e)
+                setattr(self, attr, None)
+        fl = getattr(self, "flight", None)
+        if fl is not None:
+            fl.uninstall()
+            self.flight = None
         for cb in getattr(self, "_fini_cbs", []):
             try:
                 cb()

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, TYPE_CHECKING
 
 from ..utils import debug, open_component
@@ -62,6 +63,12 @@ class Taskpool:
         self.auto_count = nb_tasks is None
         self.priority: int = 0
         self.user: Any = None
+        #: tasks retired through :meth:`task_done` (the health plane's
+        #: per-taskpool progress currency); guarded — retirements arrive
+        #: from concurrent workers and ``+=`` alone loses updates
+        self.nb_retired = 0
+        self._retire_lock = threading.Lock()
+        self._t_attached: Optional[float] = None
 
     # -- task classes -----------------------------------------------------
     def add_task_class(self, tc: TaskClass) -> TaskClass:
@@ -80,6 +87,7 @@ class Taskpool:
     def attached(self, context: "Context") -> None:
         """Called by ``Context.add_taskpool``."""
         self.context = context
+        self._t_attached = time.monotonic()
         if self._known_nb_tasks is not None:
             self.tdm.taskpool_set_nb_tasks(self, self._known_nb_tasks)
 
@@ -116,10 +124,46 @@ class Taskpool:
 
     def task_done(self, task: Optional[Task] = None) -> None:
         """Retire one task (drives termination detection)."""
+        with self._retire_lock:
+            self.nb_retired += 1
         self.tdm.taskpool_addto_nb_tasks(self, -1)
 
     def is_done(self) -> bool:
         return self._terminated.is_set()
+
+    def progress(self) -> Dict[str, Any]:
+        """Live progress snapshot for this pool — the per-taskpool slice
+        the health plane exports (``/metrics`` ``parsec_taskpool_*``
+        gauges, ``/status`` JSON): tasks retired, the known total when one
+        was declared (for auto-counted pools, retired plus the monitor's
+        outstanding count — i.e. tasks *discovered* so far), the retire
+        rate since attach, and the rate-extrapolated ETA.  ``known`` /
+        ``eta_s`` are None when the front-end discovers tasks dynamically
+        and no estimate exists yet."""
+        retired = self.nb_retired
+        known = self._known_nb_tasks
+        if known is None:
+            rem = getattr(self.tdm, "_nb_tasks", None)
+            if isinstance(rem, int) and rem >= 0:
+                known = retired + rem
+        elapsed = (time.monotonic() - self._t_attached) \
+            if self._t_attached is not None else 0.0
+        rate = retired / elapsed if elapsed > 0 else 0.0
+        eta = None
+        if known is not None and rate > 0:
+            eta = max(0.0, (known - retired) / rate)
+        return {
+            "taskpool_id": self.taskpool_id,
+            "name": self.name,
+            "type": self.taskpool_type,
+            "retired": retired,
+            "known": known,
+            "elapsed_s": round(elapsed, 6),
+            "rate_tasks_per_s": round(rate, 3),
+            "eta_s": round(eta, 3) if eta is not None else None,
+            "done": self.is_done(),
+            "failed": self.failed,
+        }
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block the caller until this taskpool quiesces

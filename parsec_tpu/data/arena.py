@@ -9,13 +9,42 @@ Reference: ``/root/reference/parsec/arena.{c,h}`` — one arena per
 from __future__ import annotations
 
 import threading
-from typing import Any, List, Optional, Tuple
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..profiling import pins
 from ..utils import mca_param
 from .data import Data, DataCopy
+
+#: every live Arena, for process-wide pressure gauges (the health plane's
+#: ``PARSEC::ARENA::*`` counters): weak, so an arena's lifetime is still
+#: owned by whoever created it
+_registry: "weakref.WeakSet[Arena]" = weakref.WeakSet()
+_registry_lock = threading.Lock()
+
+
+def all_arenas() -> "List[Arena]":
+    """Snapshot of every live arena (BytePool size classes included)."""
+    with _registry_lock:
+        return list(_registry)
+
+
+def global_stats() -> Dict[str, int]:
+    """Process-wide arena pressure: outstanding/cached buffer counts and
+    the byte totals behind them (``bytes_hw`` is the high-water mark of
+    bytes outstanding per arena, summed — the admission-control signal
+    ROADMAP item 1 needs)."""
+    out = {"arenas": 0, "used": 0, "cached": 0, "created": 0,
+           "bytes_in_use": 0, "bytes_cached": 0, "bytes_hw": 0}
+    for ar in all_arenas():
+        s = ar.stats()
+        out["arenas"] += 1
+        for k in ("used", "cached", "created",
+                  "bytes_in_use", "bytes_cached", "bytes_hw"):
+            out[k] += s[k]
+    return out
 
 #: DataCopy.flags bit: this copy's buffer has been returned to its arena.
 #: A second release of the same copy would append the buffer to the free
@@ -47,6 +76,10 @@ class Arena:
             help="max outstanding buffers per arena (0=unlimited)")
         self.nb_used = 0
         self.nb_created = 0
+        #: most buffers ever outstanding at once (under ``_lock``)
+        self.nb_used_hw = 0
+        with _registry_lock:
+            _registry.add(self)
 
     @property
     def elt_nbytes(self) -> int:
@@ -60,6 +93,8 @@ class Arena:
                 return None
             buf = self._free.pop() if self._free else None
             self.nb_used += 1
+            if self.nb_used > self.nb_used_hw:
+                self.nb_used_hw = self.nb_used
         if buf is None:
             buf = np.empty(self.shape, self.dtype)
             self.nb_created += 1
@@ -106,10 +141,15 @@ class Arena:
 
     def stats(self) -> dict:
         with self._lock:
+            nbytes = self.elt_nbytes
             return {
                 "cached": len(self._free),
                 "used": self.nb_used,
+                "used_hw": self.nb_used_hw,
                 "created": self.nb_created,
+                "bytes_in_use": self.nb_used * nbytes,
+                "bytes_cached": len(self._free) * nbytes,
+                "bytes_hw": self.nb_used_hw * nbytes,
             }
 
 
@@ -148,8 +188,8 @@ class BytePool:
             return list(self._classes.values())
 
     def stats(self) -> dict:
-        out = {"cached": 0, "used": 0, "created": 0}
+        out: Dict[str, int] = {"cached": 0, "used": 0, "created": 0}
         for ar in self.arenas():
             for k, v in ar.stats().items():
-                out[k] += v
+                out[k] = out.get(k, 0) + v
         return out

@@ -1,5 +1,7 @@
 """Observability layer (reference L7): PINS hooks, trace, DOT grapher,
-live properties dictionary, SDE counters, alperf."""
+live properties dictionary, SDE counters, alperf, and the serving-side
+health plane (HTTP metrics exporter, stall watchdog, flight recorder —
+see docs/OPERATIONS.md)."""
 
 from . import pins
 from .trace import CommProfiler, TaskProfiler, Trace
@@ -11,7 +13,8 @@ from .sde import SDEModule
 
 __all__ = ["pins", "Trace", "TaskProfiler", "CommProfiler", "DotGrapher",
            "dictionary", "sde", "SDEModule", "AlperfModule",
-           "BinaryTrace", "BinaryTaskProfiler", "RankTraceSet"]
+           "BinaryTrace", "BinaryTaskProfiler", "RankTraceSet",
+           "HealthServer", "Watchdog", "FlightRecorder"]
 
 
 def __getattr__(name):
@@ -21,4 +24,13 @@ def __getattr__(name):
         from . import binary
 
         return getattr(binary, name)
+    # health plane: lazy so importing profiling costs no http/analysis
+    if name in ("HealthServer", "Watchdog"):
+        from . import health
+
+        return getattr(health, name)
+    if name == "FlightRecorder":
+        from . import flight
+
+        return flight.FlightRecorder
     raise AttributeError(name)

@@ -42,6 +42,14 @@ _RECORD_DTYPE = np.dtype([
 
 PHASES = {0: "B", 1: "E", 2: "i", 3: "C"}
 
+#: ONE process-wide sequence behind every ``task.prof["pbt_token"]``
+#: stamp.  Coexisting recorders (an always-on flight recorder per rank
+#: plus a deliberate RankTraceSet, or a BinaryTaskProfiler) race to
+#: first-touch a task; per-instance counters would hand two distinct
+#: tasks the same token value and silently corrupt every offline
+#: token-keyed analysis once their dumps are read together.
+_PBT_TOKEN_SEQ = itertools.count(1)
+
 
 class BinaryTrace:
     """Keyword dictionary + native event sink."""
@@ -124,7 +132,6 @@ class BinaryTaskProfiler:
         self._k_exec = k("exec")
         self._k_prep = k("prepare_input")
         self._k_complete = k("complete_exec")
-        self._seq = itertools.count(1)
         self._subs = []
 
         def sub(site, cb):
@@ -135,7 +142,7 @@ class BinaryTaskProfiler:
             prof = task.prof
             t = prof.get("pbt_token")
             if t is None:
-                t = prof["pbt_token"] = next(self._seq)
+                t = prof["pbt_token"] = next(_PBT_TOKEN_SEQ)
             return t
 
         t = self.trace
@@ -179,14 +186,36 @@ class RankTraceSet:
 
     In a TCP (multi-process) launch each process is one rank: build the
     set with ``nranks=1`` and ``base_rank=<this rank>``; merge the
-    per-process dumps offline."""
+    per-process dumps offline.
 
-    def __init__(self, nranks: int = 1, base_rank: int = 0):
+    ``trace_factory(rank) -> trace`` swaps the per-rank sink: the default
+    is the native :class:`BinaryTrace`; the flight recorder
+    (:mod:`parsec_tpu.profiling.flight`) passes a bounded drop-oldest
+    ring with the same interface, reusing every routing subscriber
+    here unchanged.
+
+    ``lean=True`` drops the highest-frequency/lowest-value subscribers —
+    the select-latency/steals instrumentation (which fires on every
+    scheduler select, idle polls included: the round-7 top non-idle GIL
+    cost) and the prepare_input spans — keeping everything the offline
+    tools need (exec spans, dep edges, comm protocol + transport,
+    hb kinds).  The always-on flight recorder runs lean."""
+
+    #: distinguishes coexisting sets' per-task bookkeeping in task.prof
+    #: (an always-on flight recorder plus a deliberate trace is a normal
+    #: production combination)
+    _SET_IDS = itertools.count(1)
+
+    def __init__(self, nranks: int = 1, base_rank: int = 0,
+                 trace_factory=None, lean: bool = False):
+        if trace_factory is None:
+            trace_factory = lambda rank: BinaryTrace(rank=rank)  # noqa: E731
         self.nranks = nranks
         self.base_rank = base_rank
-        self.traces = [BinaryTrace(rank=base_rank + r)
+        self.lean = lean
+        self._class_key = f"pbt_class_{next(RankTraceSet._SET_IDS)}"
+        self.traces = [trace_factory(base_rank + r)
                        for r in range(nranks)]
-        self._seq = itertools.count(1)  # tokens unique across the set
         self._k = [
             {name: t.keyword(name) for name in
              ("exec", "prepare_input", "complete_exec", "select",
@@ -219,7 +248,14 @@ class RankTraceSet:
         prof = task.prof
         t = prof.get("pbt_token")
         if t is None:
-            t = prof["pbt_token"] = next(self._seq)
+            t = prof["pbt_token"] = next(_PBT_TOKEN_SEQ)
+        # the class:<name> instant (critpath's token -> class mapping) is
+        # per SET, not per token: the token itself is shared across
+        # coexisting sets (so their dumps agree on identity), but each
+        # set must carry the mapping in its OWN trace or the
+        # second-installed set's dump loses every class attribution
+        if self._class_key not in prof:
+            prof[self._class_key] = True
             r = self._es_rank(None, task)
             tr = self._trace_of(r)
             if tr is not None:
@@ -249,8 +285,10 @@ class RankTraceSet:
 
         sub(pins.EXEC_BEGIN, task_cb("exec", "begin"))
         sub(pins.EXEC_END, task_cb("exec", "end"))
-        sub(pins.PREPARE_INPUT_BEGIN, task_cb("prepare_input", "begin"))
-        sub(pins.PREPARE_INPUT_END, task_cb("prepare_input", "end"))
+        if not self.lean:
+            sub(pins.PREPARE_INPUT_BEGIN,
+                task_cb("prepare_input", "begin"))
+            sub(pins.PREPARE_INPUT_END, task_cb("prepare_input", "end"))
         sub(pins.COMPLETE_EXEC_BEGIN, task_cb("complete_exec", "begin"))
         sub(pins.COMPLETE_EXEC_END, task_cb("complete_exec", "end"))
 
@@ -311,8 +349,12 @@ class RankTraceSet:
                     self._steals_seen[key] = steals
                     tr.counter(ks["steals"], steals)
 
-        sub(pins.SELECT_BEGIN, on_select_begin)
-        sub(pins.SELECT_END, on_select_end)
+        if not self.lean:
+            # EVERY scheduler select enters these (idle polls included):
+            # too hot for an always-on recorder, earn-their-keep for a
+            # deliberate trace
+            sub(pins.SELECT_BEGIN, on_select_begin)
+            sub(pins.SELECT_END, on_select_end)
 
         # comm-protocol instants (fired with es=None; rank rides the
         # payload) — the events the overlap fraction counts
@@ -431,15 +473,16 @@ class RankTraceSet:
         if tr is not None:
             tr.clock_offset_ns = int(offset_ns)
 
-    def dump(self, directory: str) -> List[str]:
-        """Write one ``rank<r>.pbt`` (+ sidecar) per rank; returns the
-        paths, merge-ready for :func:`profiling.merge.merge_traces`."""
+    def dump(self, directory: str, suffix: str = ".pbt") -> List[str]:
+        """Write one ``rank<r><suffix>`` (+ sidecar) per rank; returns
+        the paths, merge-ready for :func:`profiling.merge.merge_traces`
+        (flight-recorder snapshots use ``suffix=".fr.pbt"``)."""
         import os
 
         os.makedirs(directory, exist_ok=True)
         paths = []
         for tr in self.traces:
-            p = os.path.join(directory, f"rank{tr.rank}.pbt")
+            p = os.path.join(directory, f"rank{tr.rank}{suffix}")
             tr.dump(p)
             paths.append(p)
         return paths

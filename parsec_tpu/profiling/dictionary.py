@@ -17,27 +17,51 @@ from typing import Any, Callable, Dict
 
 _lock = threading.Lock()
 _props: Dict[str, Callable[[], Any]] = {}
+#: properties whose getter already raised once (logged on first failure
+#: only — a poisoned getter sampled at 10 Hz must not flood the log)
+_err_logged: set = set()
 
 
 def register_property(name: str, getter: Callable[[], Any]) -> None:
     with _lock:
         _props[name] = getter
+        _err_logged.discard(name)  # a re-registered getter logs anew
 
 
 def unregister_property(name: str) -> None:
     with _lock:
         _props.pop(name, None)
+        _err_logged.discard(name)
 
 
-def snapshot() -> Dict[str, Any]:
+def snapshot(exclude_prefix: str = "") -> Dict[str, Any]:
+    """Sample every registered property.  A raising getter must not kill
+    the sampler (the Aggregator thread polls this forever): the failure
+    is logged ONCE per property and the property keeps being published as
+    an ``"<error: ...>"`` string — visible to monitors, fatal to nobody.
+
+    ``exclude_prefix`` skips matching properties WITHOUT sampling them —
+    for consumers that read a subset elsewhere (the Prometheus exporter
+    reads the SDE registry directly and must not pay its gauges twice)."""
     with _lock:
         items = list(_props.items())
     out = {}
     for name, getter in items:
+        if exclude_prefix and name.startswith(exclude_prefix):
+            continue
         try:
             out[name] = getter()
-        except Exception:
-            out[name] = None
+        except Exception as e:
+            with _lock:
+                first = name not in _err_logged
+                _err_logged.add(name)
+            if first:
+                from ..utils import debug
+
+                debug.warning("dictionary property %r getter raised: "
+                              "%s: %s (published as an error string; "
+                              "logged once)", name, type(e).__name__, e)
+            out[name] = f"<error: {type(e).__name__}: {e}>"
     return out
 
 

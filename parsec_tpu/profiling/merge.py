@@ -70,7 +70,16 @@ def clock_handshake(ce, *, pings: int = 8, timeout: float = 10.0) -> int:
                 state["done"] += 1
                 cv.notify_all()
 
-    ce.register_am(TAG_CTL, on_ctl)
+    # share TAG_CTL through the engine's op multiplexer: the watchdog's
+    # heartbeat channel (profiling.health) lives on the same tag, and a
+    # raw register_am here would silently unhook it for the rest of the
+    # run (register_ctl replaces only these ops, handshake after
+    # handshake)
+    if hasattr(ce, "register_ctl"):
+        for op in ("clk_ping", "clk_pong", "clk_done"):
+            ce.register_ctl(op, on_ctl)
+    else:  # bare test doubles without the CommEngine base
+        ce.register_am(TAG_CTL, on_ctl)
     deadline = time.monotonic() + timeout
     if rank == 0:
         # serve pings until every peer confirmed its estimate

@@ -17,7 +17,7 @@ enabled (PINS fire is gated on subscribers).
 from __future__ import annotations
 
 import threading
-from typing import Dict, List
+from typing import Callable, Dict, List
 
 from . import dictionary, pins
 
@@ -25,9 +25,26 @@ from . import dictionary, pins
 TASKS_ENABLED = "PARSEC::TASKS_ENABLED"
 TASKS_RETIRED = "PARSEC::TASKS_RETIRED"
 PENDING_TASKS = "PARSEC::SCHEDULER::PENDING_TASKS"
+# the serving-side gauge set (profiling.health registers these per
+# context — the comm/arena/device counters external monitors need for
+# admission control; documented in docs/OPERATIONS.md, pinned against
+# doc drift by tests/profiling/test_health.py)
+READY_TASKS = "PARSEC::SCHEDULER::READY_TASKS"
+COMM_WIRE_BYTES = "PARSEC::COMM::WIRE_BYTES"
+COMM_EAGER_HIT_RATE = "PARSEC::COMM::EAGER_HIT_RATE"
+COMM_RDV_PULLS_INFLIGHT = "PARSEC::COMM::RDV_PULLS_INFLIGHT"
+ARENA_BYTES_IN_USE = "PARSEC::ARENA::BYTES_IN_USE"
+ARENA_BYTES_HIGH_WATER = "PARSEC::ARENA::BYTES_HIGH_WATER"
+DEVICE_WAVE_OCCUPANCY = "PARSEC::DEVICE::WAVE_OCCUPANCY"
+DEVICE_TASKS_EXECUTED = "PARSEC::DEVICE::TASKS_EXECUTED"
 
 _lock = threading.Lock()
 _counters: Dict[str, float] = {}
+#: callable-backed level counters ("gauges"): read() invokes the getter —
+#: the PAPI-SDE *registered-function* counter flavor, vs the accumulated
+#: _counters (PAPI_SDE_register_counter vs _register_fp_counter)
+_gauges: Dict[str, Callable[[], float]] = {}
+_gauge_warned: set = set()
 
 
 def register_counter(name: str, initial: float = 0) -> None:
@@ -39,7 +56,20 @@ def register_counter(name: str, initial: float = 0) -> None:
 def unregister_counter(name: str) -> None:
     with _lock:
         _counters.pop(name, None)
+        _gauges.pop(name, None)
+        _gauge_warned.discard(name)
     dictionary.unregister_property(f"sde.{name}")
+
+
+def register_gauge(name: str, getter: Callable[[], float]) -> None:
+    """Register a callable-backed counter: ``read(name)`` calls
+    ``getter()`` live (queue depths, bytes-in-use — values that cannot be
+    maintained by accumulation).  Auto-published into the live-properties
+    dictionary like plain counters; unregister with
+    :func:`unregister_counter`."""
+    with _lock:
+        _gauges[name] = getter
+    dictionary.register_property(f"sde.{name}", lambda n=name: read(n))
 
 
 def counter_add(name: str, value: float) -> None:
@@ -56,17 +86,33 @@ def counter_set(name: str, value: float) -> None:
 
 def read(name: str) -> float:
     with _lock:
-        return _counters.get(name, 0)
+        getter = _gauges.get(name)
+        if getter is None:
+            return _counters.get(name, 0)
+    try:
+        return getter()
+    except Exception as e:  # a broken gauge must not kill its reader
+        with _lock:
+            first = name not in _gauge_warned
+            _gauge_warned.add(name)
+        if first:
+            from ..utils import debug
+
+            debug.warning("sde gauge %r getter raised: %s (read as 0; "
+                          "logged once)", name, e)
+        return 0.0
 
 
 def list_counters() -> List[str]:
     with _lock:
-        return sorted(_counters)
+        return sorted(set(_counters) | set(_gauges))
 
 
 def reset() -> None:
     with _lock:
         _counters.clear()
+        _gauges.clear()
+        _gauge_warned.clear()
 
 
 class SDEModule:

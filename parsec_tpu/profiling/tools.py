@@ -28,6 +28,12 @@ module is the equivalent over the framework's Chrome/Perfetto JSON traces:
   detection over binary ``.pbt`` trace dumps — unordered conflicting
   tile-version writes, arena double-recycles, late dependency releases,
   double task completions, reported as stable ``RTxxx`` findings.
+* ``flightdump`` — snapshot a live mesh's flight recorder
+  (:mod:`parsec_tpu.profiling.flight`): pass the health endpoint URL of
+  a running process (``PARSEC_TPU_HEALTH=1``) and the last-N-events ring
+  of every in-process rank lands as ``rank<r>.fr.pbt`` files — loadable
+  by ``merge`` / ``critpath`` / ``hbcheck`` exactly like a traced run
+  (see ``docs/OPERATIONS.md``).
 
 Usage::
 
@@ -405,6 +411,57 @@ def cmd_hbcheck(args) -> int:
     return 0
 
 
+def cmd_flightdump(args) -> int:
+    """Trigger + collect a flight-recorder snapshot.
+
+    ``target`` is either the base URL of a live health endpoint (the
+    server process writes ``rank<r>.fr.pbt`` files and reports their
+    paths) or, for embedded use, an output DIRECTORY — in which case the
+    recorders installed in THIS process are dumped."""
+    import os
+
+    target = args.target
+    out_dir = args.out
+    if target.startswith(("http://", "https://")):
+        import json as _json
+        import urllib.error
+        import urllib.parse
+        import urllib.request
+
+        url = target.rstrip("/") + "/flightdump"
+        if out_dir:
+            url += "?" + urllib.parse.urlencode(
+                {"dir": os.path.abspath(out_dir)})
+        try:
+            with urllib.request.urlopen(url, timeout=30) as resp:
+                doc = _json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            body = e.read().decode(errors="replace")
+            print(f"flightdump: {e.code} from {url}: {body}",
+                  file=sys.stderr)
+            return 1
+        except OSError as e:
+            print(f"flightdump: cannot reach {url}: {e}", file=sys.stderr)
+            return 1
+        paths = doc.get("paths", [])
+        for p in paths:
+            print(p)
+        print(f"flightdump: {len(paths)} snapshot(s) "
+              f"(load with: tools merge/critpath/hbcheck)")
+        return 0 if paths else 1
+    from . import flight
+
+    if not flight.installed():
+        print("flightdump: no flight recorder installed in this process "
+              "(set PARSEC_TPU_FLIGHT=1, or pass a live health endpoint "
+              "URL)", file=sys.stderr)
+        return 1
+    paths = flight.dump_all(out_dir or target, reason="tools flightdump")
+    for p in paths:
+        print(p)
+    return 0 if paths else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="parsec_tpu.profiling.tools",
@@ -472,6 +529,19 @@ def main(argv=None) -> int:
     ph.add_argument("--strict", action="store_true",
                     help="exit non-zero on warnings too, not just races")
     ph.set_defaults(fn=cmd_hbcheck)
+    pf = sub.add_parser(
+        "flightdump", help="snapshot a live mesh's flight recorder "
+        "(rank<r>.fr.pbt per rank): pass a health endpoint URL "
+        "(PARSEC_TPU_HEALTH=1 in the app) or an output directory for "
+        "in-process recorders")
+    pf.add_argument("target",
+                    help="http://host:port of a live health endpoint, or "
+                    "an output directory (in-process mode)")
+    pf.add_argument("-o", "--out",
+                    help="directory the snapshots land in (URL mode: the "
+                    "SERVER process writes there; default: its cwd or "
+                    "PARSEC_TPU_FLIGHT_DIR)")
+    pf.set_defaults(fn=cmd_flightdump)
     args = p.parse_args(argv)
     return args.fn(args)
 

@@ -1,0 +1,286 @@
+"""Runtime health plane: live /metrics//status//healthz exporter,
+standard SDE gauge set (+ doc-drift pin against docs/OPERATIONS.md),
+dictionary snapshot hardening, and the HTTP mode of the live monitor."""
+
+import json
+import re
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.comm import InprocFabric
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl.ptg import PTG, INOUT
+from parsec_tpu.profiling import dictionary, sde
+from parsec_tpu.profiling.health import (
+    HealthServer,
+    register_context_gauges,
+)
+
+
+@pytest.fixture
+def clean_sde():
+    sde.reset()
+    yield
+    sde.reset()
+
+
+class _OwnRankCollection(LocalCollection):
+    """Every tile owned by the constructing rank — gives each virtual
+    rank of the scrape test its own local chain."""
+
+    def rank_of(self, *key) -> int:
+        return self.myrank
+
+
+def _gated_chain_tp(n, gate: threading.Event, rank: int = 0, nodes: int = 1):
+    """A chain whose FIRST task blocks on ``gate``: the pool stays live
+    (1 task in a body, the rest unreleased) until the test opens it —
+    what a scrape-during-a-run needs."""
+    dc = _OwnRankCollection("D", shape=(1,), init=lambda k: np.zeros(1),
+                            nodes=nodes, myrank=rank)
+    ptg = PTG("gated")
+    step = ptg.task_class("step", k="0 .. N-1")
+    step.affinity("D(0)")
+    step.flow("X", INOUT, "<- (k == 0) ? D(0) : X step(k-1)",
+              "-> (k < N-1) ? X step(k+1) : D(0)")
+
+    def body(X, k):
+        if k == 0:
+            assert gate.wait(timeout=60)
+        X += 1.0
+
+    step.body(cpu=body)
+    return ptg.taskpool(N=n, D=dc), dc
+
+
+def _get(url: str):
+    return urllib.request.urlopen(url, timeout=10).read().decode()
+
+
+PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9eE+.]+$")
+
+
+def test_metrics_scrape_live_2rank_mesh(clean_sde):
+    """curl /metrics on a live 2-virtual-rank mesh: valid Prometheus
+    text carrying per-taskpool progress, scheduler backlog and arena
+    gauges, rank-labeled; /status carries the same as JSON; /healthz is
+    green; the gauges also landed in the SDE/dictionary registries."""
+    fabric = InprocFabric(2)
+    ces = fabric.endpoints()
+    ctxs = [Context(nb_cores=2, rank=r, nranks=2, comm=ces[r])
+            for r in range(2)]
+    servers = [HealthServer(ctx).start() for ctx in ctxs]
+    gate = threading.Event()
+    try:
+        pools = []
+        for r, ctx in enumerate(ctxs):
+            tp, _ = _gated_chain_tp(6, gate, rank=r, nodes=2)
+            ctx.add_taskpool(tp)
+            pools.append(tp)
+        # the mesh is RUNNING (rank pools wedged open on the gate): scrape
+        for r, (ctx, hs) in enumerate(zip(ctxs, servers)):
+            text = _get(hs.url + "/metrics")
+            lines = [ln for ln in text.splitlines() if ln]
+            assert lines, "empty exposition"
+            for ln in lines:
+                if ln.startswith("#"):
+                    continue
+                assert PROM_LINE.match(ln), f"invalid prom line: {ln!r}"
+            assert f'parsec_ready_tasks{{rank="{r}"' in text
+            assert f'parsec_taskpool_retired_total{{rank="{r}"' in text
+            assert "parsec_taskpool_known_tasks" in text
+            assert f'parsec_arena_bytes_in_use{{rank="{r}"}}' in text
+            assert "parsec_comm_wire_bytes_total" in text
+            assert "parsec_device_wave_occupancy" in text
+            assert 'counter="PARSEC::' in text  # SDE registry exported
+
+            st = json.loads(_get(hs.url + "/status"))
+            assert st["rank"] == r and st["nranks"] == 2
+            assert st["active_taskpools"] == 1
+            prog = st["taskpools"][0]
+            assert prog["name"] == "gated" and prog["known"] == 6
+            assert prog["retired"] < 6 and not prog["done"]
+            assert "bytes_in_use" in st["arena"]
+            assert st["comm"] is not None
+            assert st["scheduler"]["name"]
+
+            hz = json.loads(_get(hs.url + "/healthz"))
+            assert hz == {"ok": True, "rank": r, "stalled": False}
+
+        # the gauge set is also visible to dictionary/aggregator readers
+        snap = dictionary.snapshot()
+        assert f"sde.{sde.READY_TASKS}" in snap
+        assert any(k.startswith("sde.PARSEC::RANK1::") for k in snap)
+
+        gate.set()
+        for tp in pools:
+            assert tp.wait(timeout=60)
+        # after quiescence the progress metric reports completion
+        st = json.loads(_get(servers[0].url + "/status"))
+        assert st["active_taskpools"] == 0
+    finally:
+        gate.set()
+        for hs in servers:
+            hs.stop()
+        for ctx in ctxs:
+            ctx.fini()
+    # stop() unregisters the gauges — no stale rank gauges leak
+    assert sde.READY_TASKS not in sde.list_counters()
+
+
+def test_taskpool_progress_counts_rate_and_eta():
+    ctx = Context(nb_cores=2)
+    try:
+        gate = threading.Event()
+        gate.set()
+        tp, _ = _gated_chain_tp(5, gate)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=30)
+        p = tp.progress()
+        assert p["retired"] == 5 and p["known"] == 5
+        assert p["done"] and not p["failed"]
+        assert p["rate_tasks_per_s"] > 0
+        assert p["eta_s"] == 0.0
+    finally:
+        ctx.fini()
+
+
+def test_sde_doc_drift_after_dpotrf(clean_sde):
+    """Every SDE counter named in docs/OPERATIONS.md must be registered
+    after a small dpotrf run with the health gauges installed — the doc
+    table cannot silently drift from the code."""
+    import os
+
+    from parsec_tpu.datadist import TiledMatrix
+    from parsec_tpu.ops.cholesky import cholesky_ptg
+    from parsec_tpu.profiling import SDEModule
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    ops_md = os.path.join(here, "..", "..", "docs", "OPERATIONS.md")
+    with open(ops_md) as f:
+        documented = set(re.findall(r"`(PARSEC::[A-Z_:]+)`", f.read()))
+    assert documented, "docs/OPERATIONS.md names no SDE counters?"
+
+    n, nb = 64, 16
+    rng = np.random.default_rng(5)
+    M = rng.standard_normal((n, n))
+    spd = M @ M.T + n * np.eye(n)
+    mod = SDEModule()
+    ctx = Context(nb_cores=2)
+    unregister = register_context_gauges(ctx)
+    try:
+        A = TiledMatrix(n, n, nb, nb, name="A").from_array(spd)
+        tp = cholesky_ptg(use_tpu=False).taskpool(NT=A.mt, A=A)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=60)
+        registered = set(sde.list_counters())
+        missing = documented - registered
+        assert not missing, (
+            f"documented in OPERATIONS.md but not registered: {missing} "
+            f"(registered: {sorted(registered)})")
+        # and the standard set reports sane values after the run
+        # (dpotrf NT=4: 4 potrf + 6 trsm + 6 syrk + 4 gemm = 20)
+        assert sde.read(sde.TASKS_RETIRED) == 20
+        assert sde.read(sde.DEVICE_TASKS_EXECUTED) == 20
+        assert sde.read(sde.COMM_EAGER_HIT_RATE) == 1.0  # comm-less
+    finally:
+        unregister()
+        mod.disable()
+        ctx.fini()
+
+
+def test_dictionary_snapshot_survives_poisoned_getter(clean_sde):
+    """Satellite: a raising property getter must not kill the sampler —
+    logged once, published as an '<error: ...>' string, sampling keeps
+    going (Aggregator thread included)."""
+    calls = {"n": 0}
+
+    def poisoned():
+        calls["n"] += 1
+        raise RuntimeError("boom")
+
+    dictionary.register_property("test.poisoned", poisoned)
+    dictionary.register_property("test.fine", lambda: 42)
+    try:
+        s1 = dictionary.snapshot()
+        s2 = dictionary.snapshot()
+        for s in (s1, s2):
+            assert s["test.fine"] == 42
+            assert isinstance(s["test.poisoned"], str)
+            assert s["test.poisoned"].startswith("<error: RuntimeError")
+        assert calls["n"] == 2  # still SAMPLED every time (kept trying)
+
+        # the Aggregator keeps running across poisoned samples
+        import tempfile
+        import time
+
+        with tempfile.TemporaryDirectory() as d:
+            path = f"{d}/agg.jsonl"
+            agg = dictionary.Aggregator(interval=0.01, path=path).start()
+            deadline = time.time() + 10
+            while len(agg.samples) < 3 and time.time() < deadline:
+                time.sleep(0.01)
+            agg.stop()
+            assert len(agg.samples) >= 3
+            assert all(str(s["test.poisoned"]).startswith("<error:")
+                       for s in agg.samples)
+    finally:
+        dictionary.unregister_property("test.poisoned")
+        dictionary.unregister_property("test.fine")
+
+
+def test_monitor_polls_http_status(clean_sde):
+    """Satellite: monitor --follow accepts a health endpoint URL and
+    renders flattened /status samples."""
+    from parsec_tpu.profiling.monitor import main as monitor_main
+
+    ctx = Context(nb_cores=2)
+    hs = HealthServer(ctx).start()
+    try:
+        gate = threading.Event()
+        gate.set()
+        tp, _ = _gated_chain_tp(4, gate)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=30)
+        import io
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = monitor_main([hs.url, "--follow", "--interval", "0.05",
+                               "--max-updates", "2"])
+        out = buf.getvalue()
+        assert rc == 0
+        assert "scheduler.ready_tasks" in out
+        assert "2 samples" in out
+    finally:
+        hs.stop()
+        ctx.fini()
+
+
+def test_monitor_tail_handles_truncation(tmp_path):
+    """Satellite: the JSONL tail reopens from the start when the file
+    shrinks (rotation/copytruncate) instead of waiting at a stale
+    offset."""
+    from parsec_tpu.profiling.monitor import TailReader
+
+    path = tmp_path / "live.jsonl"
+    path.write_text('{"t": 1.0, "a": 1}\n{"t": 2.0, "a": 2}\n')
+    tail = TailReader(str(path))
+    assert [s["a"] for s in tail.poll()] == [1, 2]
+    assert tail.poll() == []  # nothing new
+    # rotate: the file is truncated and restarts smaller than the offset
+    path.write_text('{"t": 3.0, "a": 3}\n')
+    assert [s["a"] for s in tail.poll()] == [3]
+    # torn tail line stays pending until completed
+    with open(path, "a") as f:
+        f.write('{"t": 4.0, ')
+    assert tail.poll() == []
+    with open(path, "a") as f:
+        f.write('"a": 4}\n')
+    assert [s["a"] for s in tail.poll()] == [4]
