@@ -76,6 +76,15 @@ class Context:
         self.nranks = nranks
         self.comm = comm  # comm engine (None = single process)
 
+        # executable cache: persistent AOT compile cache + the cross-rank
+        # compile-once-ship-serialized channel (a TAG_CTL "compile" op on
+        # multi-rank meshes).  Created BEFORE devices attach — the device
+        # layer reads cache warmth to decide whether the multi-rank
+        # wave-batching auto-disable can be lifted.
+        from .. import compile_cache as _cc
+
+        self.compile_cache = _cc.for_context(self)
+
         sched_name = scheduler or str(mca_param.register(
             "mca", "sched", "", help="scheduler component selection")) or None
         self.scheduler = open_component("sched", sched_name)

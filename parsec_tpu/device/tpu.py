@@ -26,7 +26,11 @@ Departures from the reference, by TPU design:
   outputs rebind the device copies of writable flows in declaration order;
 * kernels are jit-compiled once per (body, shapes, dtypes) by XLA and
   cached — the analogue of the reference's per-task-class dyld/cubin
-  function lookup (``device_cuda_module.c`` find_function).
+  function lookup (``device_cuda_module.c`` find_function).  Compiles
+  route through the context's :mod:`~parsec_tpu.compile_cache`: a
+  persistent on-disk executable store plus, on multi-rank meshes, a
+  compile-once-ship-serialized broadcast — so neither a process restart
+  nor an N-rank mesh multiplies the XLA cold-start cost.
 """
 
 from __future__ import annotations
@@ -34,6 +38,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import threading
+import weakref
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -52,6 +57,41 @@ try:  # JAX is required for this module to be available
     _HAVE_JAX = True
 except Exception:  # pragma: no cover
     _HAVE_JAX = False
+
+
+def private_device_put(x, jdev=None, *, guard=None):
+    """``jax.device_put`` whose result is guaranteed NOT to alias
+    ``guard`` (a host numpy array someone retains).  On the CPU backend
+    PJRT zero-copies suitably-aligned host buffers, so a DONATED
+    execution of the transferred array writes straight through the
+    retained memory — the caller's reference matrix, or a version-v
+    host copy whose bytes must outlive the bump to v+1.  Whether a
+    given buffer zero-copies depends on its heap alignment, which makes
+    the clobber a per-allocation coin flip (seen as a suite flake:
+    the LU reconstruct test intermittently compared against its own
+    overwritten input).  When aliasing is detected the transfer reruns
+    from a throwaway copy — the only memory jax then aliases is
+    jax-private.  Non-CPU platforms always copy host→HBM; the check is
+    skipped there (``np.asarray`` on such arrays would be a D2H pull)."""
+    arr = jax.device_put(x, jdev) if jdev is not None else jnp.asarray(x)
+    if guard is None:
+        return arr
+    plat = getattr(jdev, "platform", None)
+    if plat is None:
+        try:
+            plat = arr.devices().pop().platform
+        except Exception:
+            plat = "cpu"  # unknown: err on the safe side
+    if plat != "cpu":
+        return arr
+    try:
+        if np.shares_memory(np.asarray(arr), guard):
+            priv = np.array(np.asarray(x), copy=True)
+            arr = jax.device_put(priv, jdev) if jdev is not None \
+                else jnp.asarray(priv)
+    except Exception:
+        pass
+    return arr
 
 
 class _InFlight:
@@ -165,6 +205,22 @@ class TpuDevice(Device):
             "device", "tpu_wave_batch", 2,
             help="min same-signature ready-wave size batched into one "
                  "program (0 disables wave batching)")
+        #: the executable cache this device compiles through (persistent
+        #: disk store + cross-rank compile broadcast; compile_cache.py)
+        self._ccache = getattr(context, "compile_cache", None)
+        if self._ccache is None:
+            from .. import compile_cache as _cc
+
+            self._ccache = _cc.default_cache()
+        #: body -> content fingerprint memo.  WEAK keys: an id()-keyed
+        #: dict here poisons the persistent cache — a body fingerprinted
+        #: just before a _jit_cache local-key HIT is never retained, so
+        #: a later different-content body can land on the recycled id
+        #: and inherit the stale fingerprint (= a wrong executable
+        #: served with plausible shapes; seen as bf16-class numerics in
+        #: an f32 run).  Weak keys die with the body instead.
+        self._body_fp: "weakref.WeakKeyDictionary" = \
+            weakref.WeakKeyDictionary()
         if (self._wave_min
                 and getattr(self.jdev, "platform", "") == "cpu"
                 and getattr(context, "nranks", 1) > 1):
@@ -173,7 +229,7 @@ class TpuDevice(Device):
                     != "default"
             except KeyError:
                 explicit = False
-            if not explicit:
+            if not explicit and not self._ccache.warm:
                 # multi-rank CPU emulation (N in-process ranks on virtual
                 # CPU devices): wave batching amortizes a device-enqueue
                 # RPC that does not exist here, while every (kernel, wave
@@ -181,7 +237,10 @@ class TpuDevice(Device):
                 # 8-rank dpotrf bench that tripled wall clock.  Real TPU
                 # (and single-rank CPU, where the compile set is paid
                 # once) keep the default; set the MCA param to force
-                # either way.
+                # either way.  A WARM executable cache lifts the
+                # workaround: wave programs reload from the disk store
+                # (and new ones ship serialized to peers), so the
+                # per-rank explosion the auto-disable dodged is gone.
                 self._wave_min = 0
         #: dual LRU of resident Data keyed by data_id (reference
         #: gpu_mem_lru / gpu_mem_owned_lru)
@@ -338,6 +397,35 @@ class TpuDevice(Device):
             task.prof["wave"] = wave
             pins.fire(site, None, task)
 
+    def _content_fp(self, body) -> str:
+        """Content fingerprint of a body callable, memoized while the
+        body object is alive (weak keys — see the _body_fp comment for
+        why id() keys are a correctness bug, not a style choice)."""
+        from ..compile_cache import code_fingerprint
+
+        try:
+            fp = self._body_fp.get(body)
+        except TypeError:  # unhashable/unweakrefable body
+            return code_fingerprint(body)
+        if fp is None:
+            fp = code_fingerprint(body)
+            try:
+                self._body_fp[body] = fp
+            except TypeError:
+                pass
+        return fp
+
+    def _cached_jit(self, local_key, content_key, fn, donate=()):
+        """One compile path for every device program: the in-device
+        ``_jit_cache`` keeps the fast id-keyed lookup the dispatch loop
+        had, while the executable cache behind it adds the persistent
+        disk store and the cross-rank compile broadcast."""
+        jitted = self._jit_cache.get(local_key)
+        if jitted is None:
+            jitted = self._jit_cache[local_key] = self._ccache.jit(
+                fn, key=content_key, donate_argnums=tuple(donate))
+        return jitted
+
     def _submit_one(self, task: Task, es) -> None:
         """Per-task submit with the retry/fail-loudly discipline."""
         try:
@@ -464,7 +552,11 @@ class TpuDevice(Device):
         from ..core import scheduling
 
         body = tasks[0].selected_chore.body_fn
-        base_key = getattr(body, "_jit_key", None) or id(body)
+        # the body OBJECT (not id(body)): an id-keyed entry outlives the
+        # body it described, and a recycled id would serve a dead body's
+        # wave program — keying on the object pins it alive instead,
+        # matching the per-task path below
+        base_key = getattr(body, "_jit_key", None) or body
         arity: Optional[int] = None
         nout: Optional[int] = None
         start = 0
@@ -478,17 +570,17 @@ class TpuDevice(Device):
                 nout = len(gst[0][1])
             start += cnt
             remaining -= cnt
-            key = ("wave", base_key, arity, nout, cnt)
-            jitted = self._jit_cache.get(key)
-            if jitted is None:
-                def _wave(*flat, _body=body, _arity=arity, _cnt=cnt):
-                    outs: List[Any] = []
-                    for t in range(_cnt):
-                        o = _body(*flat[t * _arity:(t + 1) * _arity])
-                        outs.extend(o if isinstance(o, (tuple, list))
-                                    else (o,))
-                    return tuple(outs)
-                jitted = self._jit_cache[key] = jax.jit(_wave)
+            def _wave(*flat, _body=body, _arity=arity, _cnt=cnt):
+                outs: List[Any] = []
+                for t in range(_cnt):
+                    o = _body(*flat[t * _arity:(t + 1) * _arity])
+                    outs.extend(o if isinstance(o, (tuple, list))
+                                else (o,))
+                return tuple(outs)
+            jitted = self._cached_jit(
+                ("wave", base_key, arity, nout, cnt),
+                ("wave", self._content_fp(body), arity, nout, cnt),
+                _wave)
             flat = [a for (dargs, _, _) in gst for a in dargs]
             for t in grp:
                 self._fire_exec(t, pins.EXEC_BEGIN, wave=cnt)
@@ -624,13 +716,13 @@ class TpuDevice(Device):
                     f"interleaves them ({specs})")
             split = len(dev_args) - nval
             arr_args, vals = dev_args[:split], tuple(dev_args[split:])
-            key = (base_key, vals)
-            jitted = self._jit_cache.get(key)
-            if jitted is None:
-                def _bound(*arrs, _body=body, _vals=vals):
-                    return _body(*arrs, *_vals)
-                jitted = self._jit_cache[key] = jax.jit(
-                    _bound, donate_argnums=donate)
+
+            def _bound(*arrs, _body=body, _vals=vals):
+                return _body(*arrs, *_vals)
+            jitted = self._cached_jit(
+                (base_key, vals),
+                ("static", self._content_fp(body), vals),
+                _bound, donate=donate)
             # a donating call that raises may have invalidated its input
             # buffers: the task is no longer safely retryable
             task._tpu_effects = bool(donate)
@@ -638,10 +730,9 @@ class TpuDevice(Device):
             outputs = jitted(*arr_args)
             self._fire_exec(task, pins.EXEC_END)
         else:
-            jitted = self._jit_cache.get(base_key)
-            if jitted is None:
-                jitted = self._jit_cache[base_key] = jax.jit(
-                    body, donate_argnums=donate)
+            jitted = self._cached_jit(
+                base_key, ("body", self._content_fp(body)),
+                body, donate=donate)
             task._tpu_effects = bool(donate)
             self._fire_exec(task, pins.EXEC_BEGIN)
             outputs = jitted(*dev_args)
@@ -744,7 +835,10 @@ class TpuDevice(Device):
         else:
             host = np.asarray(newest.payload)
             self._hbm_realloc(data, old, host.nbytes)
-            arr = jax.device_put(host, self.jdev)
+            # guard: the host copy RETAINS this buffer at version v — a
+            # zero-copy put followed by a donating task would overwrite
+            # it in place while its version still claims v
+            arr = private_device_put(host, self.jdev, guard=host)
             self.stats["bytes_in"] += host.nbytes
         c = data.attach_copy(self.data_index, arr)
         c.version = newest.version

@@ -67,6 +67,7 @@ class GraphExecutor:
         donate: bool = True,
         jit: bool = True,
         batch_levels: bool = False,
+        cache=None,
     ):
         """``batch_levels=True`` groups same-class tasks at the same
         dependency level and vmaps the body over each group: the emitted
@@ -277,14 +278,74 @@ class GraphExecutor:
             if donate:
                 donate_argnums = tuple(
                     i for i, k in enumerate(self.input_keys) if k in seen_out)
-            self._fn = jax.jit(entry_fn, donate_argnums=donate_argnums)
+            # compile through the executable cache: the whole-DAG program
+            # is keyed by a content digest of the plan (per-step body code
+            # hash + params + dataflow + I/O keys), so an identical
+            # taskpool rebuilt in this process is a dictionary hit and a
+            # rebuild in a NEW process reloads the serialized executable
+            # from the persistent store instead of paying the full XLA
+            # cold compile (the BENCH_r03 460 s `runtime_qr_compile_s`)
+            from ..compile_cache import default_cache
+
+            self.cache = cache if cache is not None else default_cache()
+            self.program_digest = self._plan_digest(tp)
+            self.donate_argnums = donate_argnums
+            self._fn = self.cache.jit(
+                entry_fn,
+                key=("graph", self.program_digest, batch_levels,
+                     donate_argnums),
+                donate_argnums=donate_argnums)
         else:
+            self.cache = None
+            self.program_digest = None
+            self.donate_argnums = ()
             self._fn = entry_fn
+
+    def _plan_digest(self, tp) -> str:
+        """Content digest of the emitted program: every step's body code
+        fingerprint, resolved params, dataflow sources and write-backs,
+        plus the executor's input/output key order and NEW-tile specs.
+        Anything that changes the traced program must land here — a
+        collision would serve a stale executable, so when in doubt,
+        include it."""
+        import hashlib
+
+        from ..compile_cache import _scrub, code_fingerprint
+
+        h = hashlib.sha256()
+        body_fps: Dict[int, str] = {}
+        for step in self._plan:
+            fp = body_fps.get(id(step.body))
+            if fp is None:
+                fp = body_fps[id(step.body)] = code_fingerprint(step.body)
+            h.update(repr((step.tid, fp,
+                           sorted((k, _scrub(repr(v)))
+                                  for k, v in step.params.items()),
+                           step.flow_inputs, step.writable,
+                           step.write_backs)).encode())
+            for fname, src in step.flow_inputs:
+                if src is not None and src[0] == "new":
+                    h.update(repr(
+                        ("new", fname,
+                         tp.new_tile_spec(step.tid[0], fname))).encode())
+        h.update(repr(("io", self.input_keys, self.output_keys)).encode())
+        return h.hexdigest()[:32]
 
     # ------------------------------------------------------------------
     def apply(self, feeds: Dict[Tuple[str, Tuple], Any]) -> Dict[Tuple[str, Tuple], Any]:
         """Run on explicit arrays: ``feeds[(collection_name, key)] = array``."""
+        import numpy as np
+
         ins = [feeds[k] for k in self.input_keys]
+        for i in self.donate_argnums:
+            # a donated numpy feed can be zero-copied by the transfer
+            # and then OVERWRITTEN in place by the program — never write
+            # through to the caller's array (device/tpu.py
+            # private_device_put has the full story)
+            if isinstance(ins[i], np.ndarray):
+                from ..device.tpu import private_device_put
+
+                ins[i] = private_device_put(ins[i], guard=ins[i])
         outs = self._fn(*ins)
         return dict(zip(self.output_keys, outs))
 
@@ -300,13 +361,26 @@ class GraphExecutor:
         device-resident copies."""
         import jax.numpy as jnp
 
+        import numpy as np
+
+        donated = {self.input_keys[i] for i in self.donate_argnums}
         feeds = {}
         for (cname, key) in self.input_keys:
             d = self._collection(cname).data_of(*key)
             c = d.newest_copy()
             if c is None:
                 raise RuntimeError(f"tile {cname}{key} has no valid copy")
-            feeds[(cname, key)] = jnp.asarray(c.payload)
+            if (cname, key) in donated and isinstance(c.payload, np.ndarray):
+                # the collection RETAINS this numpy payload at its
+                # current version: a donated zero-copy view would let
+                # the program overwrite it in place (device/tpu.py
+                # private_device_put)
+                from ..device.tpu import private_device_put
+
+                feeds[(cname, key)] = private_device_put(
+                    c.payload, guard=c.payload)
+            else:
+                feeds[(cname, key)] = jnp.asarray(c.payload)
         outs = self.apply(feeds)
         if block:
             for v in outs.values():

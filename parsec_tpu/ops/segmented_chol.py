@@ -314,9 +314,17 @@ class SegmentedCholesky:
     includes attach/enumeration/dispatch); the matrix stays device-resident
     across steps via the device module's stage-in/epilog path."""
 
-    def __init__(self, context, n: int, nb: int, *, bf16=False,
+    def __init__(self, context, n: int, nb="auto", *, bf16=False,
                  strip: int = 4096, tail: int = 4096,
                  specialize: str = "static"):
+        from .. import tuning
+
+        # nb="auto": the autotuner's persisted winner for (op, N, dtype,
+        # device generation) — falls back to 512 (clipped to a divisor
+        # of N) when nothing has been tuned yet ("tools autotune")
+        nb = tuning.auto_nb(nb, "dpotrf_seg", n,
+                            "bfloat16" if bf16 == "storage" else "float32",
+                            default=512, divides=n)
         self.context = context
         self.n, self.nb = n, nb
         self.store_bf16 = bf16 == "storage"
@@ -351,9 +359,13 @@ class SegmentedCholesky:
         return payload
 
     def __call__(self, A_np: np.ndarray) -> np.ndarray:
+        from ..device.tpu import private_device_put
+
         A = jnp.asarray(np.ascontiguousarray(A_np))
         if self.store_bf16:
             A = A.astype(jnp.bfloat16)
-        A = jax.device_put(A, self.device.jdev)
+        # guard=A_np: the donating in-place pipeline must never write
+        # through a zero-copy transfer into the CALLER's matrix
+        A = private_device_put(A, self.device.jdev, guard=A_np)
         out = np.asarray(jax.device_get(self.run(A)), dtype=np.float32)
         return np.tril(out)

@@ -424,10 +424,17 @@ class SegmentedLU:
     """Runtime driver: getrf a device-resident matrix through
     taskpool + scheduler + TPU device module."""
 
-    def __init__(self, context, n: int, nb: int, *, strip: int = 4096,
+    def __init__(self, context, n: int, nb="auto", *, strip: int = 4096,
                  prec=None, tail: int = 4096, specialize: str = "generic",
                  bf16=False, pivot: str = "block",
                  fused_update: bool = False, solve_prec=None):
+        from .. import tuning
+
+        # nb="auto": the autotuner's persisted winner (see
+        # SegmentedCholesky; "tools autotune --op getrf_seg")
+        nb = tuning.auto_nb(nb, "getrf_seg", n,
+                            "bfloat16" if bf16 == "storage" else "float32",
+                            default=512, divides=n)
         self.context = context
         self.n, self.nb = n, nb
         self.store_bf16 = bf16 == "storage"
@@ -474,8 +481,12 @@ class SegmentedLU:
         return payload
 
     def __call__(self, A_np: np.ndarray):
-        A = jax.device_put(jnp.asarray(np.ascontiguousarray(A_np)),
-                           self.device.jdev)
+        from ..device.tpu import private_device_put
+
+        # guard=A_np: the donating in-place pipeline must never write
+        # through a zero-copy transfer into the CALLER's matrix
+        A = private_device_put(jnp.asarray(np.ascontiguousarray(A_np)),
+                               self.device.jdev, guard=A_np)
         out = self.run(A)
         if self.pivot == "panel":
             M = np.asarray(jax.device_get(out[0]))

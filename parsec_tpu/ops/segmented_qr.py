@@ -234,9 +234,15 @@ class SegmentedQR:
     """Runtime driver: QR a device-resident matrix through
     taskpool + scheduler + TPU device module.  Returns explicit (Q, R)."""
 
-    def __init__(self, context, n: int, nb: int, *, strip: int = 4096,
+    def __init__(self, context, n: int, nb="auto", *, strip: int = 4096,
                  prec=None, specialize: str = "generic",
                  tail: int = 4096, bf16=False):
+        from .. import tuning
+
+        # nb="auto": the autotuner's persisted winner (see
+        # SegmentedCholesky; "tools autotune --op geqrf_seg")
+        nb = tuning.auto_nb(nb, "geqrf_seg", n, "float32",
+                            default=512, divides=n)
         self.context = context
         self.n, self.nb = n, nb
         self.nt_tasks = n_segments(n, nb, tail)
@@ -279,8 +285,12 @@ class SegmentedQR:
         return out[0], out[1]
 
     def __call__(self, A_np: np.ndarray):
-        A = jax.device_put(jnp.asarray(np.ascontiguousarray(A_np)),
-                           self.device.jdev)
+        from ..device.tpu import private_device_put
+
+        # guard=A_np: the donating in-place pipeline must never write
+        # through a zero-copy transfer into the CALLER's matrix
+        A = private_device_put(jnp.asarray(np.ascontiguousarray(A_np)),
+                               self.device.jdev, guard=A_np)
         Q, R = self.run(A)
         Qh = np.asarray(jax.device_get(Q), dtype=np.float32)
         Rh = np.asarray(jax.device_get(R), dtype=np.float32)

@@ -23,6 +23,7 @@ tables.
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import json
 import threading
@@ -221,7 +222,7 @@ class RankTraceSet:
              ("exec", "prepare_input", "complete_exec", "select",
               "dep_edge", "comm_send", "comm_recv", "comm_ctl",
               "comm_recv_eager", "comm_recv_rdv", "frame_coalesced",
-              "ce_send", "ce_recv", "qdepth", "steals",
+              "ce_send", "ce_recv", "qdepth", "steals", "compile",
               # happens-before event kinds (analysis.hb / tools hbcheck;
               # TRACING.md "hb event kinds")
               "hb_dep_dec", "hb_ver_bump", "hb_arena_alloc",
@@ -420,6 +421,39 @@ class RankTraceSet:
         sub(pins.COMM_SEND_END, wire_cb("ce_send", "end"))
         sub(pins.COMM_RECV_BEGIN, wire_cb("ce_recv", "begin"))
         sub(pins.COMM_RECV_END, wire_cb("ce_recv", "end"))
+
+        # executable-cache compile spans (rare, kept in lean mode too):
+        # event_id = fingerprint hash so B/E pair up; END's info carries
+        # the resolution kind (0 = full miss, 1 = disk/bcast hit) — the
+        # critpath ``compile`` bucket reads the span, tools read the kind
+        def compile_cb(phase):
+            def cb(es, p):
+                p = p or {}
+                tr = self._trace_of(p.get("rank", self.base_rank))
+                if tr is None:
+                    return
+                # stable across processes/ranks (hash() is seeded per
+                # process): the fingerprint is a hex digest, so its
+                # leading nibbles ARE a deterministic id
+                fps = p.get("fp", "") or "0"
+                try:
+                    eid = int(fps[:15], 16)
+                except ValueError:
+                    eid = int.from_bytes(
+                        hashlib.blake2b(fps.encode(),
+                                        digest_size=8).digest(),
+                        "big") & 0x7FFFFFFFFFFFFFFF
+                info = 0
+                if phase == "end" and str(p.get("kind", "")).startswith(
+                        "hit"):
+                    info = 1
+                getattr(tr, phase)(
+                    self._k[tr.rank - self.base_rank]["compile"], eid,
+                    info)
+            return cb
+
+        sub(pins.COMPILE_BEGIN, compile_cb("begin"))
+        sub(pins.COMPILE_END, compile_cb("end"))
 
         # happens-before instants (tools hbcheck reconstructs the event
         # streams offline — analysis.hb.analyze_trace).  Sites without a

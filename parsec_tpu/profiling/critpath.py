@@ -6,11 +6,15 @@ studies (the R/python analyses around ``profile2h5``); the round-5
 review diagnosed the dynamic path's ~0.5 ms/task host-bound gap only by
 hand-rolled A/B timing.  This module turns that into a tool: walk the
 recorded dependency edges backwards from the last-finishing task, and
-attribute every microsecond on the chain to one of three buckets —
+attribute every microsecond on the chain to one of four buckets —
 
 * **compute** — the task's own ``exec`` span;
 * **comm**    — the part of the pre-task gap covered by transport
   activity on the SAME rank track (``ce_recv`` / ``ce_send`` spans);
+* **compile** — the part covered by executable-cache compile spans
+  (``compile`` spans from :mod:`parsec_tpu.compile_cache`): XLA
+  trace/compile time stalling the chain — the cold-start cost the
+  persistent cache exists to eliminate;
 * **host gap** — the rest: scheduler select, release bookkeeping,
   dispatch latency — time nobody computes and nothing is on the wire.
 
@@ -36,6 +40,9 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 #: transport span names that count as wire time in gap attribution
 COMM_SPAN_NAMES = ("ce_recv", "ce_send")
+#: executable-cache span names that count as compilation time in gap
+#: attribution (compile_cache.py fires them; binary traces record them)
+COMPILE_SPAN_NAMES = ("compile",)
 
 
 def _merge_intervals(iv: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
@@ -63,7 +70,8 @@ def _overlap(lo: float, hi: float, merged: Sequence[Tuple[float, float]]) -> flo
 
 
 def analyze(events: List[dict], *, exec_name: str = "exec",
-            comm_names: Sequence[str] = COMM_SPAN_NAMES) -> dict:
+            comm_names: Sequence[str] = COMM_SPAN_NAMES,
+            compile_names: Sequence[str] = COMPILE_SPAN_NAMES) -> dict:
     """Reconstruct the dependency critical path and attribute its wall
     time.  Returns a report dict::
 
@@ -84,6 +92,8 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
     preds: Dict[Tuple[Any, int], List[Tuple[Any, int]]] = defaultdict(list)
     comm_open: Dict[Tuple[Any, Any, str], float] = {}
     comm_iv: Dict[Any, List[Tuple[float, float]]] = defaultdict(list)
+    compile_open: Dict[Tuple[Any, Any, str], float] = {}
+    compile_iv: Dict[Any, List[Tuple[float, float]]] = defaultdict(list)
     #: protocol-regime accounting from the tagged payload instants
     #: (comm_recv_eager / comm_recv_rdv, profiling.binary): events +
     #: bytes per wire regime, so comm time on the chain can be read
@@ -131,14 +141,24 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
                 b = comm_open.pop(ckey, None)
                 if b is not None:
                     comm_iv[pid].append((b, e["ts"]))
+        elif name in compile_names:
+            ckey = (pid, e.get("tid"), name)
+            if ph == "B":
+                compile_open[ckey] = e["ts"]
+            elif ph == "E":
+                b = compile_open.pop(ckey, None)
+                if b is not None:
+                    compile_iv[pid].append((b, e["ts"]))
 
     empty = {"wall_us": 0.0, "n_tasks": 0, "coverage": 0.0,
              "buckets": {"compute_us": 0.0, "comm_us": 0.0,
-                         "host_gap_us": 0.0},
+                         "compile_us": 0.0, "host_gap_us": 0.0},
              "per_class": {}, "chain": [], "comm_regimes": regimes}
     if not tasks:
         return empty
     comm_merged = {pid: _merge_intervals(iv) for pid, iv in comm_iv.items()}
+    compile_merged = {pid: _merge_intervals(iv)
+                      for pid, iv in compile_iv.items()}
 
     # backward walk from the last-finishing task: at each step pick the
     # predecessor that finished last (the binding one)
@@ -154,10 +174,11 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
         chain.append(cur)
     chain.reverse()
 
-    buckets = {"compute_us": 0.0, "comm_us": 0.0, "host_gap_us": 0.0}
+    buckets = {"compute_us": 0.0, "comm_us": 0.0, "compile_us": 0.0,
+               "host_gap_us": 0.0}
     per_class: Dict[str, Dict[str, float]] = defaultdict(
         lambda: {"count": 0, "compute_us": 0.0, "comm_us": 0.0,
-                 "host_gap_us": 0.0})
+                 "compile_us": 0.0, "host_gap_us": 0.0})
     rows = []
     prev_end: Optional[float] = None
     for key in chain:
@@ -168,17 +189,26 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
         gap = 0.0 if prev_end is None else max(0.0, t["begin"] - prev_end)
         gap_comm = _overlap(t["begin"] - gap, t["begin"],
                             comm_merged.get(pid, ()))
+        gap_compile = _overlap(t["begin"] - gap, t["begin"],
+                               compile_merged.get(pid, ()))
+        # comm and compile windows can overlap the same gap (a manager
+        # compiling while a frame drains): never attribute a microsecond
+        # twice — the compile share is capped by what comm left over
+        gap_compile = min(gap_compile, max(0.0, gap - gap_comm))
         buckets["compute_us"] += dur
         buckets["comm_us"] += gap_comm
-        buckets["host_gap_us"] += gap - gap_comm
+        buckets["compile_us"] += gap_compile
+        buckets["host_gap_us"] += gap - gap_comm - gap_compile
         pc = per_class[cls]
         pc["count"] += 1
         pc["compute_us"] += dur
         pc["comm_us"] += gap_comm
-        pc["host_gap_us"] += gap - gap_comm
+        pc["compile_us"] += gap_compile
+        pc["host_gap_us"] += gap - gap_comm - gap_compile
         rows.append({"token": tok, "pid": pid, "class": cls,
                      "begin_us": t["begin"], "end_us": t["end"],
-                     "gap_us": gap, "gap_comm_us": gap_comm})
+                     "gap_us": gap, "gap_comm_us": gap_comm,
+                     "gap_compile_us": gap_compile})
         prev_end = max(t["end"], prev_end or t["end"])
     wall = tasks[chain[-1]]["end"] - tasks[chain[0]]["begin"]
     attributed = sum(buckets.values())
@@ -202,9 +232,10 @@ def render(report: dict) -> str:
         f"wall {wall / 1e3:.3f} ms, "
         f"coverage {report['coverage']:.1%}",
     ]
-    for k in ("compute_us", "comm_us", "host_gap_us"):
-        frac = b[k] / wall if wall > 0 else 0.0
-        lines.append(f"  {k[:-3]:<10} {b[k] / 1e3:>10.3f} ms  {frac:>6.1%}")
+    for k in ("compute_us", "comm_us", "compile_us", "host_gap_us"):
+        frac = b.get(k, 0.0) / wall if wall > 0 else 0.0
+        lines.append(f"  {k[:-3]:<10} {b.get(k, 0.0) / 1e3:>10.3f} ms"
+                     f"  {frac:>6.1%}")
     reg = report.get("comm_regimes")
     if reg and (reg["eager"]["events"] or reg["rdv"]["events"]):
         ev_e, ev_r = reg["eager"]["events"], reg["rdv"].get("transfers", 0)

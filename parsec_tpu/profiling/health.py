@@ -139,6 +139,8 @@ def context_status(ctx) -> Dict[str, Any]:
         "devices": [_device_summary(d) for d in ctx.devices],
         "sde": {name: sde.read(name) for name in sde.list_counters()
                 if name not in own},
+        "compile_cache": (None if getattr(ctx, "compile_cache", None)
+                          is None else ctx.compile_cache.snapshot()),
         "watchdog": None if wd is None else wd.status(),
     }
     return doc
@@ -194,6 +196,22 @@ def register_context_gauges(ctx) -> Callable[[], None]:
     gauge(sde.DEVICE_TASKS_EXECUTED,
           lambda: float(sum(int(d.stats.get("executed_tasks", 0))
                             for d in ctx.devices)))
+
+    # executable-cache counters (compile_cache.ExecutableCache.stats):
+    # cache effectiveness + the compile-once-ship-serialized channel
+    def cc_val(key: str):
+        def get() -> float:
+            cc = getattr(ctx, "compile_cache", None)
+            if cc is None:
+                return 0.0
+            return float(cc.snapshot().get(key, 0))
+        return get
+
+    gauge(sde.COMPILE_CACHE_HITS, cc_val("hits"))
+    gauge(sde.COMPILE_CACHE_MISSES, cc_val("misses"))
+    gauge(sde.COMPILE_CACHE_BYTES, cc_val("bytes"))
+    gauge(sde.COMPILE_BCAST_SENT, cc_val("bcast_sent"))
+    gauge(sde.COMPILE_BCAST_RECV, cc_val("bcast_recv"))
 
     # lets context_status/prometheus_text skip this context's own gauges
     # (exported under first-class names) instead of sampling them twice
@@ -297,6 +315,19 @@ def prometheus_text(ctx) -> str:
               d["wave_occupancy"])
         _line(out, "parsec_device_tasks_executed_total", lab,
               d["executed_tasks"])
+
+    cc = doc.get("compile_cache")
+    if cc is not None:
+        out.append("# TYPE parsec_compile_cache_hits_total counter")
+        _line(out, "parsec_compile_cache_hits_total", r, cc.get("hits", 0))
+        _line(out, "parsec_compile_cache_misses_total", r,
+              cc.get("misses", 0))
+        _line(out, "parsec_compile_cache_bytes_total", r,
+              cc.get("bytes", 0))
+        _line(out, "parsec_compile_bcast_sent_total", r,
+              cc.get("bcast_sent", 0))
+        _line(out, "parsec_compile_bcast_recv_total", r,
+              cc.get("bcast_recv", 0))
 
     wd = doc["watchdog"]
     _line(out, "parsec_watchdog_stalled", r,

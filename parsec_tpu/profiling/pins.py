@@ -69,6 +69,13 @@ NATIVE_TASK_DONE = "native_task_done"    # {"graph","task","accepted"}
 # device-retired write looks unordered (COMPLETE_EXEC_BEGIN fires later,
 # after the bumps)
 DEVICE_EPILOG_BEGIN = "device_epilog_begin"
+# executable-cache compile spans (compile_cache.py): one begin/end pair
+# around every cache resolution that was not an in-process hit — payload
+# {"rank","fp","key"} (+ "kind": hit_disk|hit_bcast|miss and "seconds"
+# on END).  Recorded into the binary traces as ``compile`` spans so
+# profiling.critpath can attribute critical-path time to compilation.
+COMPILE_BEGIN = "compile_begin"
+COMPILE_END = "compile_end"
 
 ALL_SITES = [v for k, v in list(globals().items()) if k.isupper() and isinstance(v, str)]
 

@@ -37,6 +37,13 @@ ARENA_BYTES_IN_USE = "PARSEC::ARENA::BYTES_IN_USE"
 ARENA_BYTES_HIGH_WATER = "PARSEC::ARENA::BYTES_HIGH_WATER"
 DEVICE_WAVE_OCCUPANCY = "PARSEC::DEVICE::WAVE_OCCUPANCY"
 DEVICE_TASKS_EXECUTED = "PARSEC::DEVICE::TASKS_EXECUTED"
+# executable-cache counters (compile_cache.py; per-context caches are
+# surfaced as gauges by profiling.health.register_context_gauges)
+COMPILE_CACHE_HITS = "PARSEC::COMPILE::CACHE_HITS"
+COMPILE_CACHE_MISSES = "PARSEC::COMPILE::CACHE_MISSES"
+COMPILE_CACHE_BYTES = "PARSEC::COMPILE::CACHE_BYTES"
+COMPILE_BCAST_SENT = "PARSEC::COMPILE::BCAST_SENT"
+COMPILE_BCAST_RECV = "PARSEC::COMPILE::BCAST_RECV"
 
 _lock = threading.Lock()
 _counters: Dict[str, float] = {}

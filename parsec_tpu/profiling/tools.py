@@ -462,6 +462,119 @@ def cmd_flightdump(args) -> int:
     return 0 if paths else 1
 
 
+def _cache_store(args):
+    """(executable store, tuning store) for the CLI — both rooted in
+    --dir when given, so stats/purge never mix an explicit root's
+    executables with the default root's tuning winners."""
+    import os as _os
+
+    from .. import compile_cache as cc
+    from .. import tuning
+
+    if getattr(args, "dir", None):
+        return (cc.DiskStore(_os.path.join(args.dir, "exe")),
+                tuning.TuningStore(_os.path.join(args.dir, "autotune")))
+    store = cc.default_store()
+    if store is None:
+        print("compile cache disabled (PARSEC_TPU_COMPILE_CACHE=0); "
+              "pass --dir to inspect a specific store", file=sys.stderr)
+    return store, tuning.default_store()
+
+
+def cmd_cache(args) -> int:
+    """Inspect / maintain the persistent executable cache
+    (``ls``/``stats``/``purge``/``verify``) and its tuning sidecar."""
+    store, tuning_store = _cache_store(args)
+    if store is None:
+        return 1
+    op = args.op
+    if op == "ls":
+        rows = store.entries()
+        for r in rows:
+            meta = r.get("meta") or {}
+            state = "CORRUPT" if r.get("corrupt") else (
+                "native+hlo" if meta.get("native_meta") else "hlo")
+            print(f"{r['fp']}  {r.get('size', 0):>10}  {state:<10} "
+                  f"{meta.get('backend', '?'):<6} "
+                  f"{meta.get('compile_s', '?'):>8}s  "
+                  f"{meta.get('key', '')}")
+        print(f"{len(rows)} entr{'y' if len(rows) == 1 else 'ies'} "
+              f"in {store.dir}")
+        return 0
+    if op == "stats":
+        rows = store.entries()
+        total = sum(r.get("size", 0) for r in rows)
+        corrupt = sum(1 for r in rows if r.get("corrupt"))
+        native = sum(1 for r in rows
+                     if (r.get("meta") or {}).get("native_meta"))
+        saved = sum((r.get("meta") or {}).get("compile_s", 0) or 0
+                    for r in rows)
+        print(f"store:          {store.dir}")
+        print(f"entries:        {len(rows)} ({corrupt} corrupt, "
+              f"{native} with native executables)")
+        print(f"bytes:          {total}")
+        print(f"compile_s sum:  {saved:.1f}  (cold cost the store "
+              "amortizes)")
+        tun = tuning_store.entries()
+        print(f"tuning entries: {len(tun)}")
+        return 0
+    if op == "purge":
+        n = store.purge(stale_only=args.stale)
+        print(f"purged {n} executable entr{'y' if n == 1 else 'ies'}")
+        if args.tuning:
+            t = tuning_store.purge()
+            print(f"purged {t} tuning entr{'y' if t == 1 else 'ies'}")
+        return 0
+    if op == "verify":
+        ok, bad = store.verify()
+        for fp in bad:
+            print(f"CORRUPT {fp}")
+        print(f"verify: {ok} ok, {len(bad)} corrupt"
+              + (" (removed)" if bad and args.delete else ""))
+        if bad and args.delete:
+            import os as _os
+
+            for fp in bad:
+                try:
+                    _os.unlink(store.path(fp))
+                except OSError:
+                    pass
+        return 1 if bad else 0
+    print(f"unknown cache op {op!r}", file=sys.stderr)
+    return 2
+
+
+def cmd_autotune(args) -> int:
+    """Search nb (and optionally the device wave-batch minimum) for an
+    op by timed short runs; winners persist next to the executable
+    cache and are picked up by ``nb="auto"``."""
+    from .. import tuning
+
+    cands = None
+    if args.nb:
+        cands = [int(x) for x in args.nb.split(",")]
+    if args.wave:
+        doc = tuning.autotune_wave(
+            n=args.n, nb=(cands[0] if cands else 64),
+            dtype=args.dtype, reps=args.reps)
+        print(f"wave search on dpotrf N={args.n}: best "
+              f"tpu_wave_batch={doc['best']}")
+        for k, v in sorted(doc["timings_s"].items(),
+                           key=lambda kv: kv[1]):
+            print(f"  wave={k:>5}  {v:.3f}s")
+        return 0
+    doc = tuning.autotune_nb(args.op, args.n, args.dtype,
+                             candidates=cands, reps=args.reps)
+    print(f"{args.op} N={args.n} {doc['dtype']} on "
+          f"{doc['device_kind']}: best nb={doc['best']}")
+    for k, v in sorted(doc["timings_s"].items(), key=lambda kv: kv[1]):
+        print(f"  nb={k:>5}  {v:.3f}s")
+    for k, why in doc.get("failures", {}).items():
+        print(f"  nb={k:>5}  FAILED: {why}")
+    print(f'persisted; ops pick it up via nb="auto"')
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="parsec_tpu.profiling.tools",
@@ -542,6 +655,40 @@ def main(argv=None) -> int:
                     "SERVER process writes there; default: its cwd or "
                     "PARSEC_TPU_FLIGHT_DIR)")
     pf.set_defaults(fn=cmd_flightdump)
+    pe = sub.add_parser(
+        "cache", help="persistent executable cache maintenance: list "
+        "entries, stats, purge, integrity verify "
+        "(PARSEC_TPU_COMPILE_CACHE governs the store location)")
+    pe.add_argument("op", choices=("ls", "stats", "purge", "verify"))
+    pe.add_argument("--dir", help="inspect an explicit cache root "
+                    "instead of the resolved default")
+    pe.add_argument("--stale", action="store_true",
+                    help="purge: only remove corrupt entries and those "
+                    "from other jax/jaxlib versions or cache formats")
+    pe.add_argument("--tuning", action="store_true",
+                    help="purge: also drop autotune winners")
+    pe.add_argument("--delete", action="store_true",
+                    help="verify: remove entries that fail validation")
+    pe.set_defaults(fn=cmd_cache)
+    pa = sub.add_parser(
+        "autotune", help="search nb (tile size) / wave-batch by timed "
+        "short runs; winners persist next to the executable cache and "
+        'apply via nb="auto"')
+    pa.add_argument("--op", default="dpotrf",
+                    help="workload to tune (built-in: dpotrf, "
+                    "dpotrf_seg, getrf_seg, geqrf_seg — the _seg names "
+                    'are the keys the segmented drivers\' nb="auto" '
+                    "reads)")
+    pa.add_argument("--n", type=int, default=1024, help="matrix size")
+    pa.add_argument("--nb", help="comma-separated nb candidates "
+                    "(default: divisors of N from 64..1024)")
+    pa.add_argument("--dtype", default="float32")
+    pa.add_argument("--reps", type=int, default=2,
+                    help="timed reps per candidate (median wins)")
+    pa.add_argument("--wave", action="store_true",
+                    help="search the device wave-batch minimum instead "
+                    "of nb")
+    pa.set_defaults(fn=cmd_autotune)
     args = p.parse_args(argv)
     return args.fn(args)
 

@@ -21,6 +21,19 @@ if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "
 # a pytest plugin may import jax before this conftest runs, in which case the
 # env vars above are ignored — set the config directly (safe before the
 # backend is initialized, i.e. before any jax.devices() call)
+# hermetic executable cache: tests must not read (or pollute) the
+# operator's ~/.cache/parsec_tpu — a per-session tmp dir keeps runs
+# reproducible (the warm-cache device behaviors are tested explicitly
+# with seeded stores).  An explicit env setting wins, as everywhere.
+import atexit  # noqa: E402
+import shutil  # noqa: E402
+import tempfile  # noqa: E402
+
+if "PARSEC_TPU_COMPILE_CACHE" not in os.environ:
+    _cache_tmp = tempfile.mkdtemp(prefix="parsec_tpu_test_cache_")
+    os.environ["PARSEC_TPU_COMPILE_CACHE"] = _cache_tmp
+    atexit.register(shutil.rmtree, _cache_tmp, True)
+
 import jax  # noqa: E402
 
 jax.config.update("jax_enable_x64", True)

@@ -205,8 +205,12 @@ def test_native_device_critpath_attributes_waves(tmp_path):
     # recover a real dependency chain, not a single orphan span
     assert rep["n_tasks"] >= 4
     assert rep["buckets"]["compute_us"] > 0
-    # device spans exist: no all-host-gap attribution
-    assert rep["buckets"]["compute_us"] > 0.2 * rep["wall_us"]
+    # device spans exist: no all-host-gap attribution.  The floor is
+    # ABSOLUTE, not a fraction of wall: with the executable cache a
+    # warm-process run no longer pays jit compiles inside its first
+    # exec spans, so honest pure-compute spans are microseconds while
+    # the fixed host costs around them are not.
+    assert rep["buckets"]["compute_us"] > 100.0  # us: real device spans
 
 
 def test_native_device_use_globals_value_order():

@@ -79,6 +79,36 @@ def test_critpath_empty_and_render():
     assert "host_gap" in text and "update" in text
 
 
+def test_critpath_compile_bucket():
+    """Compile spans (the executable cache's PINS events in the binary
+    traces) are their own attribution bucket: the part of a pre-task
+    gap covered by a ``compile`` span is cold-start cost, not host gap —
+    and a microsecond double-covered by comm is never counted twice."""
+    evs = golden_events()
+    # a compile span covering [260, 300]: 40 us of the B->C gap
+    evs += _span("compile", 0, 260, 300, tid="mgr")
+    rep = critpath.analyze(evs)
+    b = rep["buckets"]
+    assert b["compile_us"] == pytest.approx(40.0)
+    assert b["compute_us"] == pytest.approx(300.0)
+    assert b["comm_us"] == pytest.approx(30.0)
+    assert b["host_gap_us"] == pytest.approx(30.0)  # 70 - 40
+    assert rep["coverage"] == pytest.approx(1.0)
+    assert rep["per_class"]["update"]["compile_us"] == pytest.approx(40.0)
+    assert rep["chain"][2]["gap_compile_us"] == pytest.approx(40.0)
+    # overlapping comm+compile windows: compile only gets what comm left
+    evs2 = golden_events()
+    evs2 += _span("compile", 0, 100, 140, tid="mgr")  # overlaps ce_recv
+    b2 = critpath.analyze(evs2)["buckets"]
+    assert b2["comm_us"] == pytest.approx(30.0)
+    # compile overlap (40) is capped at what comm left of the gap (20):
+    # the attribution never exceeds the gap
+    assert b2["compile_us"] == pytest.approx(20.0)
+    assert b2["comm_us"] + b2["compile_us"] + b2["host_gap_us"] \
+        == pytest.approx(100.0)
+    assert "compile" in critpath.render(critpath.analyze(evs))
+
+
 @pytest.mark.skipif(
     not __import__("parsec_tpu").native.available(),
     reason="binary tracer needs the native core")

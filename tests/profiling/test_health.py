@@ -97,6 +97,8 @@ def test_metrics_scrape_live_2rank_mesh(clean_sde):
             assert f'parsec_arena_bytes_in_use{{rank="{r}"}}' in text
             assert "parsec_comm_wire_bytes_total" in text
             assert "parsec_device_wave_occupancy" in text
+            assert f'parsec_compile_cache_hits_total{{rank="{r}"}}' in text
+            assert "parsec_compile_bcast_sent_total" in text
             assert 'counter="PARSEC::' in text  # SDE registry exported
 
             st = json.loads(_get(hs.url + "/status"))
@@ -165,6 +167,11 @@ def test_sde_doc_drift_after_dpotrf(clean_sde):
     with open(ops_md) as f:
         documented = set(re.findall(r"`(PARSEC::[A-Z_:]+)`", f.read()))
     assert documented, "docs/OPERATIONS.md names no SDE counters?"
+    # the executable-cache counter set must stay documented (round-9):
+    # removing a row from OPERATIONS.md is doc drift too
+    assert {sde.COMPILE_CACHE_HITS, sde.COMPILE_CACHE_MISSES,
+            sde.COMPILE_CACHE_BYTES, sde.COMPILE_BCAST_SENT,
+            sde.COMPILE_BCAST_RECV} <= documented
 
     n, nb = 64, 16
     rng = np.random.default_rng(5)

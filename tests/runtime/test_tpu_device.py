@@ -447,3 +447,36 @@ def test_wave_staging_is_per_chunk(ctx):
     assert kinds == (["stage"] * 4 + ["dispatch"] * 4
                      + ["stage"] * 2 + ["dispatch"] * 2), kinds
     assert [v for (k, v) in events if k == "dispatch"] == [4, 4, 4, 4, 2, 2]
+
+
+def test_body_fingerprint_memo_is_weak(ctx):
+    """Cache-poisoning regression pin: the device's body-fingerprint
+    memo must NOT key on id(body).  A body fingerprinted just before a
+    _jit_cache local-key hit is never retained, so its id can be
+    recycled by a later DIFFERENT-content body — an id-keyed memo then
+    hands the new body the dead body's fingerprint and the executable
+    cache serves the wrong program with plausible shapes (seen in the
+    suite as bf16-class numerics in an f32 LU run).  Weak keys make the
+    entry die with the body."""
+    import gc
+
+    dev = tpu_dev(ctx)
+
+    def make(scale):
+        def body(x, _s=scale):
+            return x * _s
+        return body
+
+    b1 = make(1.0)
+    fp1 = dev._content_fp(b1)
+    assert dev._content_fp(b1) == fp1  # memo hit while alive
+    assert len(dev._body_fp) >= 1
+    n_before = len(dev._body_fp)
+    del b1
+    gc.collect()
+    # the dead body's entry is GONE — nothing for a recycled id to hit
+    assert len(dev._body_fp) == n_before - 1
+    # and a different-content body never inherits a stale fingerprint,
+    # wherever the allocator places it
+    b2 = make(2.0)
+    assert dev._content_fp(b2) != fp1
