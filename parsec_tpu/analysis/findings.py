@@ -98,6 +98,12 @@ CODES = {
     "OBS006": (WARNING, "ready tasks queued but none retiring: the "
                         "scheduler backlog is frozen (workers wedged, or "
                         "every ready task blocked inside its body)"),
+    "OBS007": (WARNING, "collective operation in flight at stall: a "
+                        "started allreduce/reduce-scatter/allgather/"
+                        "bcast/redistribution never completed (a group "
+                        "rank never joined, or its segments stopped "
+                        "landing) — the finding names the op and its "
+                        "step position"),
 }
 
 

@@ -10,12 +10,17 @@ from .engine import (
     TAG_PUT,
     TAG_TERMDET,
 )
+from .coll import CollError, CollManager, CollOp, RedistOp
 from .inproc import InprocComm, InprocFabric
 from .remote_dep import RemoteDepManager
 from .tcp import TCPComm, endpoint_from_env
 
 __all__ = [
     "CommEngine",
+    "CollError",
+    "CollManager",
+    "CollOp",
+    "RedistOp",
     "InprocComm",
     "InprocFabric",
     "RemoteDepManager",

@@ -112,6 +112,43 @@ class CommEngine(Component):
         from .remote_dep import RemoteDepManager
 
         self.remote_dep = RemoteDepManager(self)
+        # collectives endpoint: created eagerly so the "coll" control op
+        # is registered before any peer's first advert can arrive
+        _ = self.coll
+
+    #: lazily-built collectives endpoint (bare engines outside a context
+    #: build it on first touch — do that BEFORE exchanging collectives)
+    _coll_mgr = None
+    _coll_lock = threading.Lock()
+
+    @property
+    def coll(self):
+        """The per-rank :class:`~parsec_tpu.comm.coll.CollManager`."""
+        mgr = self._coll_mgr
+        if mgr is None:
+            with CommEngine._coll_lock:
+                mgr = self._coll_mgr
+                if mgr is None:
+                    from .coll import CollManager
+
+                    mgr = self._coll_mgr = CollManager(self)
+        return mgr
+
+    # -- collective conveniences (TCP + inproc parity: both speak the
+    # same ctl-advert + chunked one-sided pull protocol) ------------------
+    def coll_allreduce(self, arr, **kw):
+        """Nonblocking allreduce; see :meth:`coll.CollManager.allreduce`.
+        Returns a handle — ``wait()`` it, read ``result()``."""
+        return self.coll.allreduce(arr, **kw)
+
+    def coll_reduce_scatter(self, arr, **kw):
+        return self.coll.reduce_scatter(arr, **kw)
+
+    def coll_allgather(self, arr, **kw):
+        return self.coll.allgather(arr, **kw)
+
+    def coll_bcast(self, arr, **kw):
+        return self.coll.bcast(arr, **kw)
 
     def detach_context(self, context: "Context") -> None:
         pass

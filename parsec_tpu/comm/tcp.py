@@ -906,6 +906,11 @@ class TCPComm(CommEngine):
             traceback.print_exc()
 
     # -- CE vtable misc ---------------------------------------------------
+    #: a dedicated comm thread owns the sockets and drives all progress —
+    #: callers blocked on comm completions (coll wait) should SLEEP on
+    #: their condvar, not spin-pump (the reference's funnelled mode)
+    self_progressing = True
+
     def progress_nonblocking(self) -> int:
         # a dedicated comm thread owns the sockets; workers have nothing
         # to drive (reference multi-node mode: comm thread does it all)

@@ -193,3 +193,32 @@ class BytePool:
             for k, v in ar.stats().items():
                 out[k] = out.get(k, 0) + v
         return out
+
+class ByteBudget:
+    """Thread-safe extra-memory meter with a declared limit: consumers
+    (the memory-bounded redistribution rounds, ``comm.coll.RedistOp``)
+    ``acquire``/``release`` the CAPACITY of every staging/landing buffer
+    they hold; the measured ``peak`` is reported against the limit
+    (``RedistOp.result()['peak_extra_bytes']``, asserted <= budget in
+    tests and the bench leg).  The meter records — it never blocks:
+    admission control (one landing batch at a time, one staging batch
+    per ack window) is the caller's bounding mechanism, and a meter that
+    blocked a comm callback would wedge the fabric."""
+
+    __slots__ = ("limit", "now", "peak", "_lock")
+
+    def __init__(self, limit: int):
+        self.limit = int(limit)
+        self.now = 0
+        self.peak = 0
+        self._lock = threading.Lock()
+
+    def acquire(self, nbytes: int) -> None:
+        with self._lock:
+            self.now += int(nbytes)
+            if self.now > self.peak:
+                self.peak = self.now
+
+    def release(self, nbytes: int) -> None:
+        with self._lock:
+            self.now -= int(nbytes)

@@ -1,6 +1,7 @@
 """DSL front-ends (reference L5): DTD dynamic insertion, PTG builder,
 JDF file compiler (``parsec_ptgpp`` analogue)."""
 
+from .collective import CollectiveTask
 from .jdf import JDF, compile_jdf, compile_jdf_file
 from .ptg import PTG, PTGTaskClass, PTGTaskpool
 from .dtd import (
@@ -17,6 +18,7 @@ from .dtd import (
 )
 
 __all__ = [
+    "CollectiveTask",
     "JDF",
     "compile_jdf",
     "compile_jdf_file",

@@ -223,6 +223,7 @@ class RankTraceSet:
               "dep_edge", "comm_send", "comm_recv", "comm_ctl",
               "comm_recv_eager", "comm_recv_rdv", "frame_coalesced",
               "ce_send", "ce_recv", "qdepth", "steals", "compile",
+              "coll", "coll_seg",
               # happens-before event kinds (analysis.hb / tools hbcheck;
               # TRACING.md "hb event kinds")
               "hb_dep_dec", "hb_ver_bump", "hb_arena_alloc",
@@ -454,6 +455,38 @@ class RankTraceSet:
 
         sub(pins.COMPILE_BEGIN, compile_cb("begin"))
         sub(pins.COMPILE_END, compile_cb("end"))
+
+        # collective spans (comm/coll.py): one begin/end per CollOp,
+        # event_id = the op's deterministic cid token (identical on
+        # every participating rank, so merged traces pair them up);
+        # info = payload bytes.  The critpath ``coll`` bucket reads the
+        # span.  One ``coll_seg`` instant per landed segment (event_id =
+        # token, info = segment index) — per-chunk frequency, dropped in
+        # lean mode like the other high-rate instants.
+        def coll_cb(phase):
+            def cb(es, p):
+                p = p or {}
+                tr = self._trace_of(p.get("rank", self.base_rank))
+                if tr is not None:
+                    getattr(tr, phase)(
+                        self._k[tr.rank - self.base_rank]["coll"],
+                        int(p.get("id", 0)) & 0x7FFFFFFFFFFFFFFF,
+                        int(p.get("bytes", 0)))
+            return cb
+
+        sub(pins.COLL_BEGIN, coll_cb("begin"))
+        sub(pins.COLL_END, coll_cb("end"))
+        if not self.lean:
+            def coll_seg_cb(es, p):
+                p = p or {}
+                tr = self._trace_of(p.get("rank", self.base_rank))
+                if tr is not None:
+                    tr.instant(
+                        self._k[tr.rank - self.base_rank]["coll_seg"],
+                        int(p.get("id", 0)) & 0x7FFFFFFFFFFFFFFF,
+                        int(p.get("seg", 0)))
+
+            sub(pins.COLL_SEG, coll_seg_cb)
 
         # happens-before instants (tools hbcheck reconstructs the event
         # streams offline — analysis.hb.analyze_trace).  Sites without a

@@ -15,6 +15,9 @@ attribute every microsecond on the chain to one of four buckets —
   (``compile`` spans from :mod:`parsec_tpu.compile_cache`): XLA
   trace/compile time stalling the chain — the cold-start cost the
   persistent cache exists to eliminate;
+* **coll**    — the part covered by runtime-collective spans (``coll``
+  spans from :mod:`parsec_tpu.comm.coll`): allreduce / reduce-scatter /
+  allgather / bcast / redistribution rounds stalling the chain;
 * **host gap** — the rest: scheduler select, release bookkeeping,
   dispatch latency — time nobody computes and nothing is on the wire.
 
@@ -43,6 +46,9 @@ COMM_SPAN_NAMES = ("ce_recv", "ce_send")
 #: executable-cache span names that count as compilation time in gap
 #: attribution (compile_cache.py fires them; binary traces record them)
 COMPILE_SPAN_NAMES = ("compile",)
+#: runtime-collective span names that count as collective time in gap
+#: attribution (comm/coll.py fires them; binary traces record them)
+COLL_SPAN_NAMES = ("coll",)
 
 
 def _merge_intervals(iv: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
@@ -71,16 +77,19 @@ def _overlap(lo: float, hi: float, merged: Sequence[Tuple[float, float]]) -> flo
 
 def analyze(events: List[dict], *, exec_name: str = "exec",
             comm_names: Sequence[str] = COMM_SPAN_NAMES,
-            compile_names: Sequence[str] = COMPILE_SPAN_NAMES) -> dict:
+            compile_names: Sequence[str] = COMPILE_SPAN_NAMES,
+            coll_names: Sequence[str] = COLL_SPAN_NAMES) -> dict:
     """Reconstruct the dependency critical path and attribute its wall
     time.  Returns a report dict::
 
         {"wall_us", "n_tasks", "coverage",
-         "buckets": {"compute_us", "comm_us", "host_gap_us"},
-         "per_class": {cls: {"count", "compute_us", "comm_us",
-                             "host_gap_us"}},
+         "buckets": {"compute_us", "comm_us", "coll_us", "compile_us",
+                     "host_gap_us"},
+         "per_class": {cls: {"count", "compute_us", "comm_us", "coll_us",
+                             "compile_us", "host_gap_us"}},
          "chain": [{"token", "pid", "class", "begin_us", "end_us",
-                    "gap_us", "gap_comm_us"}]}
+                    "gap_us", "gap_comm_us", "gap_coll_us",
+                    "gap_compile_us"}]}
 
     ``coverage`` is the attributed fraction of the chain's wall clock —
     1.0 when every pre-task gap is non-negative (async device completion
@@ -94,6 +103,11 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
     comm_iv: Dict[Any, List[Tuple[float, float]]] = defaultdict(list)
     compile_open: Dict[Tuple[Any, Any, str], float] = {}
     compile_iv: Dict[Any, List[Tuple[float, float]]] = defaultdict(list)
+    # collective spans pair B/E by event_id (the deterministic cid
+    # token), not tid: the begin fires on the issuing thread and the end
+    # on whichever comm callback completed the op
+    coll_open: Dict[Tuple[Any, Any, str], float] = {}
+    coll_iv: Dict[Any, List[Tuple[float, float]]] = defaultdict(list)
     #: protocol-regime accounting from the tagged payload instants
     #: (comm_recv_eager / comm_recv_rdv, profiling.binary): events +
     #: bytes per wire regime, so comm time on the chain can be read
@@ -149,16 +163,27 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
                 b = compile_open.pop(ckey, None)
                 if b is not None:
                     compile_iv[pid].append((b, e["ts"]))
+        elif name in coll_names:
+            ckey = (pid, args.get("event_id"), name)
+            if ph == "B":
+                coll_open[ckey] = e["ts"]
+            elif ph == "E":
+                b = coll_open.pop(ckey, None)
+                if b is not None:
+                    coll_iv[pid].append((b, e["ts"]))
 
     empty = {"wall_us": 0.0, "n_tasks": 0, "coverage": 0.0,
              "buckets": {"compute_us": 0.0, "comm_us": 0.0,
-                         "compile_us": 0.0, "host_gap_us": 0.0},
+                         "coll_us": 0.0, "compile_us": 0.0,
+                         "host_gap_us": 0.0},
              "per_class": {}, "chain": [], "comm_regimes": regimes}
     if not tasks:
         return empty
     comm_merged = {pid: _merge_intervals(iv) for pid, iv in comm_iv.items()}
     compile_merged = {pid: _merge_intervals(iv)
                       for pid, iv in compile_iv.items()}
+    coll_merged = {pid: _merge_intervals(iv)
+                   for pid, iv in coll_iv.items()}
 
     # backward walk from the last-finishing task: at each step pick the
     # predecessor that finished last (the binding one)
@@ -174,11 +199,11 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
         chain.append(cur)
     chain.reverse()
 
-    buckets = {"compute_us": 0.0, "comm_us": 0.0, "compile_us": 0.0,
-               "host_gap_us": 0.0}
+    buckets = {"compute_us": 0.0, "comm_us": 0.0, "coll_us": 0.0,
+               "compile_us": 0.0, "host_gap_us": 0.0}
     per_class: Dict[str, Dict[str, float]] = defaultdict(
         lambda: {"count": 0, "compute_us": 0.0, "comm_us": 0.0,
-                 "compile_us": 0.0, "host_gap_us": 0.0})
+                 "coll_us": 0.0, "compile_us": 0.0, "host_gap_us": 0.0})
     rows = []
     prev_end: Optional[float] = None
     for key in chain:
@@ -189,25 +214,34 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
         gap = 0.0 if prev_end is None else max(0.0, t["begin"] - prev_end)
         gap_comm = _overlap(t["begin"] - gap, t["begin"],
                             comm_merged.get(pid, ()))
+        gap_coll = _overlap(t["begin"] - gap, t["begin"],
+                            coll_merged.get(pid, ()))
         gap_compile = _overlap(t["begin"] - gap, t["begin"],
                                compile_merged.get(pid, ()))
-        # comm and compile windows can overlap the same gap (a manager
-        # compiling while a frame drains): never attribute a microsecond
-        # twice — the compile share is capped by what comm left over
-        gap_compile = min(gap_compile, max(0.0, gap - gap_comm))
+        # comm/coll/compile windows can overlap the same gap (a manager
+        # compiling while a frame drains, a collective streaming over
+        # the transport it is itself a span above): never attribute a
+        # microsecond twice — each later bucket is capped by what the
+        # earlier ones left over (comm wins, then coll, then compile)
+        gap_coll = min(gap_coll, max(0.0, gap - gap_comm))
+        gap_compile = min(gap_compile,
+                          max(0.0, gap - gap_comm - gap_coll))
         buckets["compute_us"] += dur
         buckets["comm_us"] += gap_comm
+        buckets["coll_us"] += gap_coll
         buckets["compile_us"] += gap_compile
-        buckets["host_gap_us"] += gap - gap_comm - gap_compile
+        buckets["host_gap_us"] += gap - gap_comm - gap_coll - gap_compile
         pc = per_class[cls]
         pc["count"] += 1
         pc["compute_us"] += dur
         pc["comm_us"] += gap_comm
+        pc["coll_us"] += gap_coll
         pc["compile_us"] += gap_compile
-        pc["host_gap_us"] += gap - gap_comm - gap_compile
+        pc["host_gap_us"] += gap - gap_comm - gap_coll - gap_compile
         rows.append({"token": tok, "pid": pid, "class": cls,
                      "begin_us": t["begin"], "end_us": t["end"],
                      "gap_us": gap, "gap_comm_us": gap_comm,
+                     "gap_coll_us": gap_coll,
                      "gap_compile_us": gap_compile})
         prev_end = max(t["end"], prev_end or t["end"])
     wall = tasks[chain[-1]]["end"] - tasks[chain[0]]["begin"]
@@ -232,7 +266,8 @@ def render(report: dict) -> str:
         f"wall {wall / 1e3:.3f} ms, "
         f"coverage {report['coverage']:.1%}",
     ]
-    for k in ("compute_us", "comm_us", "compile_us", "host_gap_us"):
+    for k in ("compute_us", "comm_us", "coll_us", "compile_us",
+              "host_gap_us"):
         frac = b.get(k, 0.0) / wall if wall > 0 else 0.0
         lines.append(f"  {k[:-3]:<10} {b.get(k, 0.0) / 1e3:>10.3f} ms"
                      f"  {frac:>6.1%}")

@@ -88,6 +88,17 @@ def _comm_summary(ctx) -> Optional[Dict[str, Any]]:
     return out
 
 
+def _coll_summary(ctx) -> Optional[Dict[str, Any]]:
+    """Collective-endpoint counters (``parsec_coll_*`` on /metrics, the
+    ``PARSEC::COLL::*`` SDE gauges).  Reads the manager only if one was
+    already built — a scrape must not instantiate comm machinery."""
+    ce = getattr(ctx, "comm", None)
+    mgr = getattr(ce, "_coll_mgr", None) if ce is not None else None
+    if mgr is None:
+        return None
+    return mgr.summary()
+
+
 def _device_summary(dev) -> Dict[str, Any]:
     s = getattr(dev, "stats", {})
     waves = int(s.get("wave_submits", 0))
@@ -136,6 +147,7 @@ def context_status(ctx) -> Dict[str, Any]:
         "active_taskpools": len(pools),
         "arena": arena_mod.global_stats(),
         "comm": _comm_summary(ctx),
+        "coll": _coll_summary(ctx),
         "devices": [_device_summary(d) for d in ctx.devices],
         "sde": {name: sde.read(name) for name in sde.list_counters()
                 if name not in own},
@@ -212,6 +224,19 @@ def register_context_gauges(ctx) -> Callable[[], None]:
     gauge(sde.COMPILE_CACHE_BYTES, cc_val("bytes"))
     gauge(sde.COMPILE_BCAST_SENT, cc_val("bcast_sent"))
     gauge(sde.COMPILE_BCAST_RECV, cc_val("bcast_recv"))
+
+    # collective-endpoint counters (comm/coll.py): ops/bytes/segments —
+    # zero until the first collective builds the manager
+    def coll_val(key: str):
+        def get() -> float:
+            c = _coll_summary(ctx)
+            return float(c.get(key, 0)) if c else 0.0
+        return get
+
+    gauge(sde.COLL_OPS_STARTED, coll_val("ops_started"))
+    gauge(sde.COLL_OPS_DONE, coll_val("ops_done"))
+    gauge(sde.COLL_BYTES, coll_val("bytes"))
+    gauge(sde.COLL_SEGMENTS_INFLIGHT, coll_val("segments_inflight"))
 
     # lets context_status/prometheus_text skip this context's own gauges
     # (exported under first-class names) instead of sampling them twice
@@ -328,6 +353,21 @@ def prometheus_text(ctx) -> str:
               cc.get("bcast_sent", 0))
         _line(out, "parsec_compile_bcast_recv_total", r,
               cc.get("bcast_recv", 0))
+
+    co = doc.get("coll")
+    if co is not None:
+        out.append("# TYPE parsec_coll_ops_started_total counter")
+        _line(out, "parsec_coll_ops_started_total", r,
+              co.get("ops_started", 0))
+        _line(out, "parsec_coll_ops_done_total", r, co.get("ops_done", 0))
+        _line(out, "parsec_coll_ops_failed_total", r,
+              co.get("ops_failed", 0))
+        _line(out, "parsec_coll_bytes_total", r, co.get("bytes", 0))
+        _line(out, "parsec_coll_segments_total", r, co.get("segments", 0))
+        out.append("# TYPE parsec_coll_segments_inflight gauge")
+        _line(out, "parsec_coll_segments_inflight", r,
+              co.get("segments_inflight", 0))
+        _line(out, "parsec_coll_ops_inflight", r, co.get("ops_inflight", 0))
 
     wd = doc["watchdog"]
     _line(out, "parsec_watchdog_stalled", r,
@@ -808,6 +848,18 @@ class Watchdog:
                     f"flight ({int(rd.stats['rdv_chunks_req'])} chunks "
                     f"requested, {int(rd.stats['rdv_bytes'])} bytes "
                     f"landed)", count=inflight))
+
+        # wedged collectives: every bound-but-unfinished CollOp, by name
+        # and step position (the op's state() line)
+        coll = getattr(ce, "_coll_mgr", None) if ce is not None else None
+        if coll is not None:
+            lines = coll.ops_in_flight()
+            for line in lines:
+                findings.append(Finding(
+                    "OBS007",
+                    f"rank {ctx.rank}: collective in flight at stall: "
+                    f"{line} ({coll.segments_in_flight()} segment(s) in "
+                    f"flight endpoint-wide)"))
 
         # scheduler backlog frozen?
         backlog = int(ctx.scheduler.pending_estimate())

@@ -69,6 +69,16 @@ NATIVE_TASK_DONE = "native_task_done"    # {"graph","task","accepted"}
 # device-retired write looks unordered (COMPLETE_EXEC_BEGIN fires later,
 # after the bumps)
 DEVICE_EPILOG_BEGIN = "device_epilog_begin"
+# collective spans (comm/coll.py): one begin/end pair per CollOp —
+# payload {"rank","id","kind","bytes","nranks"} (+ "seconds"/"failed" on
+# END; "id" is the deterministic 63-bit cid token) — plus one COLL_SEG
+# instant per landed segment {"rank","peer","bytes","id","seg","nsegs"}.
+# Recorded as ``coll`` spans / ``coll_seg`` instants in binary traces;
+# profiling.critpath attributes gap time under them to the ``coll``
+# bucket.
+COLL_BEGIN = "coll_begin"
+COLL_END = "coll_end"
+COLL_SEG = "coll_seg"
 # executable-cache compile spans (compile_cache.py): one begin/end pair
 # around every cache resolution that was not an in-process hit — payload
 # {"rank","fp","key"} (+ "kind": hit_disk|hit_bcast|miss and "seconds"

@@ -44,6 +44,12 @@ COMPILE_CACHE_MISSES = "PARSEC::COMPILE::CACHE_MISSES"
 COMPILE_CACHE_BYTES = "PARSEC::COMPILE::CACHE_BYTES"
 COMPILE_BCAST_SENT = "PARSEC::COMPILE::BCAST_SENT"
 COMPILE_BCAST_RECV = "PARSEC::COMPILE::BCAST_RECV"
+# runtime-collective counters (comm/coll.py CollManager.summary —
+# allreduce / reduce-scatter / allgather / bcast / redistribution rounds)
+COLL_OPS_STARTED = "PARSEC::COLL::OPS_STARTED"
+COLL_OPS_DONE = "PARSEC::COLL::OPS_DONE"
+COLL_BYTES = "PARSEC::COLL::BYTES"
+COLL_SEGMENTS_INFLIGHT = "PARSEC::COLL::SEGMENTS_INFLIGHT"
 
 _lock = threading.Lock()
 _counters: Dict[str, float] = {}

@@ -99,6 +99,108 @@ def test_reduce_rows_multirank():
                 assert rows[i] is None
 
 
+@pytest.mark.parametrize("algo", ["dtd", "coll"])
+def test_redistribute_misaligned_offsets_vs_numpy(algo):
+    """PR-8 satellite pin: misaligned windows (ia/ja/ib/jb != 0) over
+    NON-dividing tile sizes against a pure-numpy reference — the old
+    all-pairs DTD path and the new memory-bounded collective path must
+    both be bit-identical to it (redistribution is a pure copy), and the
+    collective path must respect its extra-memory budget."""
+    NR = 2
+    M_S, N_S = 23, 29          # 8x8 source tiles: ragged last row/col
+    M_T, N_T = 27, 25          # 6x10 target tiles: ragged + different
+    m, n = 17, 13              # window smaller than either matrix
+    ia, ja, ib, jb = 3, 2, 5, 4
+    budget = 1 << 20
+    rng = np.random.default_rng(42)
+    GS = rng.standard_normal((M_S, N_S))
+    sentinel = -7.25  # exactly representable: untouched cells must keep it
+
+    results = {}
+    pools = {}
+
+    def build(rank, ctx):
+        S = TwoDimBlockCyclic(M_S, N_S, 8, 8, p=2, q=1, myrank=rank,
+                              name="S")
+        for (i, j) in S.local_tiles():
+            ti, tj = S.tile_shape(i, j)
+            S.data_of(i, j).newest_copy().payload[:] = \
+                GS[i * 8:i * 8 + ti, j * 8:j * 8 + tj]
+        T = TwoDimBlockCyclic(M_T, N_T, 6, 10, p=1, q=2, myrank=rank,
+                              name="T")
+        for (i, j) in T.local_tiles():
+            T.data_of(i, j).newest_copy().payload[:] = sentinel
+        results[rank] = T
+        tp = redistribute(ctx, S, T, m=m, n=n, ia=ia, ja=ja, ib=ib,
+                          jb=jb, algo=algo, mem_budget=budget)
+        pools[rank] = tp
+        return tp
+
+    run_ranks(NR, build, timeout=120)
+
+    GT = np.full((M_T, N_T), sentinel)
+    GT[ib:ib + m, jb:jb + n] = GS[ia:ia + m, ja:ja + n]
+    for rank in range(NR):
+        T = results[rank]
+        for (i, j) in T.local_tiles():
+            ti, tj = T.tile_shape(i, j)
+            want = GT[i * 6:i * 6 + ti, j * 10:j * 10 + tj]
+            got = T.data_of(i, j).newest_copy().payload
+            # bit-identical: a redistribution is a copy, not arithmetic
+            np.testing.assert_array_equal(
+                got, want, err_msg=f"tile {(i, j)} on rank {rank}")
+        assert pools[rank].user["algo"] == algo
+        if algo == "coll":
+            peak = pools[rank].user["peak_extra_bytes"]
+            assert 0 < peak <= budget, (rank, pools[rank].user)
+
+
+def test_redistribute_coll_budget_bounds_peak():
+    """The collective path's measured peak extra memory tracks the
+    configured budget: a tight budget forces more, smaller rounds (lower
+    peak) than a loose one, and both stay within their limits while
+    producing identical bytes."""
+    NR, M, N, MB, NB = 2, 48, 48, 8, 8
+    peaks = {}
+
+    def run(budget):
+        results = {}
+
+        def build(rank, ctx):
+            S = TwoDimBlockCyclic(M, N, MB, NB, p=2, q=1, myrank=rank,
+                                  name="S")
+            _filled(S)
+            T = TwoDimBlockCyclic(M, N, 6, 10, p=1, q=2, myrank=rank,
+                                  name="T")
+            for (i, j) in T.local_tiles():
+                T.data_of(i, j).newest_copy().payload[:] = 0.0
+            results[rank] = T
+            tp = redistribute(ctx, S, T, algo="coll", mem_budget=budget)
+            peaks.setdefault(budget, {})[rank] = tp
+            return tp
+
+        run_ranks(NR, build, timeout=120)
+        return results
+
+    tight, loose = 4096, 1 << 22
+    res_tight = run(tight)
+    res_loose = run(loose)
+    G = _expected_global(M, N, MB, NB)
+    for rank in range(NR):
+        for res in (res_tight, res_loose):
+            T = res[rank]
+            for (i, j) in T.local_tiles():
+                ti, tj = T.tile_shape(i, j)
+                want = G[i * 6:i * 6 + ti, j * 10:j * 10 + tj]
+                np.testing.assert_array_equal(
+                    T.data_of(i, j).newest_copy().payload, want)
+        for budget in (tight, loose):
+            tp = peaks[budget][rank]
+            peak = tp.user["peak_extra_bytes"]
+            assert peak <= budget, (budget, rank, tp.user)
+            assert tp.user["budget"] == budget
+
+
 def test_rank_mismatch_refused():
     """A 4-rank distribution under a 1-rank context must refuse loudly
     (remote tiles would silently materialize as zeros)."""

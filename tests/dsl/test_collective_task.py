@@ -1,0 +1,196 @@
+"""CollectiveTask — collectives as DTD graph nodes.
+
+The task form must (a) order like any task (after local producers of
+the tile, before local consumers), (b) be termdet-safe (the pool
+quiesces only after the collective completes), and (c) stay
+bit-identical and hb-clean under seeded schedule perturbation (the
+schedule-explorer leg, per the PR-5 discipline for anything that blocks
+a worker on cross-rank state)."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl import CollectiveTask
+from parsec_tpu.dsl.dtd import AFFINITY, DTDTaskpool, IN, INOUT
+
+from tests.runtime.test_multirank import run_ranks
+
+NR = 4
+
+
+def _mesh_collection(rank, name="C", shape=(8,)):
+    """One tile per rank, owned by that rank."""
+    dc = LocalCollection(name, shape=shape, nodes=NR, myrank=rank,
+                         init=lambda k: np.zeros(shape))
+    dc.rank_of = lambda *key: key[0] % NR
+    return dc
+
+
+def test_allreduce_node_orders_in_graph():
+    """produce -> allreduce -> consume per rank: the consume body must
+    observe the fully-reduced value (the collective node ordered between
+    them through normal INOUT dependencies)."""
+    seen = {}
+
+    def build(rank, ctx):
+        dc = _mesh_collection(rank)
+        tiles = {r: dc.data_of(r) for r in range(NR)}
+        tp = DTDTaskpool(ctx, name="ctask")
+
+        def produce(arr, _r=rank):
+            arr[:] = np.arange(8.0) * (_r + 1)
+
+        def consume(arr, _r=rank):
+            seen[_r] = arr.copy()
+
+        # SPMD: every rank inserts ALL ranks' produce/consume (remote
+        # ones become shadow tasks), exactly like any distributed DTD
+        for r in range(NR):
+            tp.insert_task(produce if r == rank else (lambda a: None),
+                           (tiles[r], INOUT | AFFINITY), name="produce")
+        CollectiveTask.allreduce(tp, tiles)
+        for r in range(NR):
+            tp.insert_task(consume if r == rank else (lambda a: None),
+                           (tiles[r], IN | AFFINITY), name="consume")
+        return tp
+
+    run_ranks(NR, build, timeout=60)
+    ref = sum(np.arange(8.0) * (r + 1) for r in range(NR))
+    for r in range(NR):
+        np.testing.assert_array_equal(seen[r], ref)
+
+
+def test_bcast_node():
+    seen = {}
+
+    def build(rank, ctx):
+        dc = _mesh_collection(rank)
+        tiles = {r: dc.data_of(r) for r in range(NR)}
+        tp = DTDTaskpool(ctx, name="cbcast")
+
+        def produce(arr, _r=rank):
+            arr[:] = np.arange(8.0) * 7 if _r == 2 else 0.0
+
+        def consume(arr, _r=rank):
+            seen[_r] = arr.copy()
+
+        for r in range(NR):
+            tp.insert_task(produce if r == rank else (lambda a: None),
+                           (tiles[r], INOUT | AFFINITY), name="produce")
+        CollectiveTask.bcast(tp, tiles, root=2)
+        for r in range(NR):
+            tp.insert_task(consume if r == rank else (lambda a: None),
+                           (tiles[r], IN | AFFINITY), name="consume")
+        return tp
+
+    run_ranks(NR, build, timeout=60)
+    for r in range(NR):
+        np.testing.assert_array_equal(seen[r], np.arange(8.0) * 7)
+
+
+def test_two_collectives_sequence_deterministically():
+    """Two back-to-back allreduces on the same tiles: the SPMD sequence
+    counter gives them distinct, rank-agreed collective ids — they must
+    not cross-talk."""
+    seen = {}
+
+    def build(rank, ctx):
+        dc = _mesh_collection(rank)
+        tiles = {r: dc.data_of(r) for r in range(NR)}
+        tp = DTDTaskpool(ctx, name="cseq")
+
+        def produce(arr, _r=rank):
+            arr[:] = float(_r + 1)
+
+        def consume(arr, _r=rank):
+            seen[_r] = arr.copy()
+
+        for r in range(NR):
+            tp.insert_task(produce if r == rank else (lambda a: None),
+                           (tiles[r], INOUT | AFFINITY), name="produce")
+        CollectiveTask.allreduce(tp, tiles)            # -> 1+2+3+4 = 10
+        CollectiveTask.allreduce(tp, tiles, op="max")  # -> max(10..) = 10
+        for r in range(NR):
+            tp.insert_task(consume if r == rank else (lambda a: None),
+                           (tiles[r], IN | AFFINITY), name="consume")
+        return tp
+
+    run_ranks(NR, build, timeout=60)
+    for r in range(NR):
+        np.testing.assert_array_equal(seen[r], np.full(8, 10.0))
+
+
+def test_collective_task_needs_context():
+    tp = DTDTaskpool(None, name="bare")
+    with pytest.raises(RuntimeError, match="context-attached"):
+        CollectiveTask.allreduce(tp, {0: None})
+
+
+def test_single_rank_is_identity():
+    """A 1-rank mesh: the allreduce node is the identity (and must not
+    require a comm engine)."""
+    from parsec_tpu import Context
+
+    seen = {}
+    with Context(nb_cores=2) as ctx:
+        dc = LocalCollection("C", shape=(4,),
+                             init=lambda k: np.arange(4.0))
+        tp = DTDTaskpool(ctx, name="solo")
+        CollectiveTask.allreduce(tp, {0: dc.data_of(0)}, group=[0])
+        def consume(a):
+            seen[0] = a.copy()
+
+        tp.insert_task(consume, (dc.data_of(0), IN), name="consume")
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=30)
+    np.testing.assert_array_equal(seen[0], np.arange(4.0))
+
+
+# ---------------------------------------------------------------------------
+# schedule-explorer leg: seeded perturbations, bit-identical + hb-clean
+# ---------------------------------------------------------------------------
+
+def _build_coll_graph(rank, ctx):
+    """The explorer's build shape: (taskpool, user)."""
+    nr = ctx.nranks
+    dc = LocalCollection("X", shape=(6,), nodes=nr, myrank=rank,
+                         init=lambda k: np.zeros(6))
+    dc.rank_of = lambda *key: key[0] % nr
+    tiles = {r: dc.data_of(r) for r in range(nr)}
+    tp = DTDTaskpool(ctx, name="coll_explore")
+
+    def produce(arr, _r=rank):
+        arr[:] = np.arange(6.0) + 10.0 * _r
+
+    for r in range(nr):
+        tp.insert_task(produce if r == rank else (lambda a: None),
+                       (tiles[r], INOUT | AFFINITY), name="produce")
+    CollectiveTask.allreduce(tp, tiles)
+    return tp, dc
+
+
+def test_explorer_collective_graph_identical_and_raceless():
+    """4 seeds of pop-order/timing/frame-delivery perturbation on the
+    CollectiveTask graph: every seed quiesces, tiles are bit-identical,
+    hb-check is clean (the collective's HB_FRAME edges order its
+    completions)."""
+    from parsec_tpu.analysis.schedules import explore
+
+    def snap(users):
+        # LocalCollection has no local_tiles(); digest each rank's OWN
+        # tile (the one its produce/collective nodes execute on)
+        out = []
+        for u in users:
+            c = u.data_of(u.myrank).newest_copy()
+            out.append((u.myrank, np.asarray(c.payload).tobytes()))
+        return out
+
+    res = explore(_build_coll_graph, nranks=2, seeds=range(4), timeout=90,
+                  snapshot=snap)
+    assert res.identical
+    assert res.race_findings() == []
+    # and the content is RIGHT: both ranks' tiles hold the reduction
+    ref = (np.arange(6.0) + (np.arange(6.0) + 10.0)).tobytes()
+    for rank, raw in res.digests[res.seeds[0]]:
+        assert raw == ref, (rank, np.frombuffer(raw))

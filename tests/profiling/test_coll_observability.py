@@ -1,0 +1,191 @@
+"""Collective observability (PR-8 satellite): per-collective spans in
+the binary traces (kind ``coll``, paired across ranks by the
+deterministic cid token, one ``coll_seg`` instant per landed segment),
+the ``parsec_coll_*`` /metrics + SDE gauge surface, and the watchdog's
+OBS007 wedged-collective diagnosis naming the op."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm.inproc import InprocFabric
+from parsec_tpu.utils import mca_param
+
+
+def _native_or_skip():
+    from parsec_tpu import native
+
+    if not native.available():
+        pytest.skip(f"native core unavailable: {native.build_error()}")
+
+
+def _run_all(engines, fn, ranks=None):
+    ranks = list(ranks if ranks is not None else range(len(engines)))
+    out, errs = {}, []
+
+    def worker(r):
+        try:
+            out[r] = fn(r, engines[r])
+        except Exception as e:
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in ranks]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert all(not t.is_alive() for t in ts), "collective wedged"
+    if errs:
+        raise errs[0][1]
+    return out
+
+
+def test_coll_spans_and_segments_in_binary_trace(tmp_path):
+    """A 2-rank allreduce under the rank tracer: every rank's trace
+    carries ONE ``coll`` begin/end span whose event_id is the SAME
+    deterministic token on both ranks (merged traces pair them up), with
+    the payload bytes in ``info`` — plus per-segment ``coll_seg``
+    instants carrying the segment index."""
+    _native_or_skip()
+    from parsec_tpu.profiling.binary import RankTraceSet
+    from parsec_tpu.profiling.merge import merge_traces
+
+    nranks = 2
+    mca_param.set_param("runtime", "coll_segment", 64)
+    traces = RankTraceSet(nranks).install()
+    try:
+        fab = InprocFabric(nranks)
+        engines = fab.endpoints()
+        for e in engines:
+            _ = e.coll
+        payload = np.arange(128, dtype=np.float64)  # 1 KiB: 16 segments
+
+        def go(r, ce):
+            h = ce.coll_allreduce(payload * (r + 1))
+            assert h.wait(timeout=30)
+
+        _run_all(engines, go)
+        paths = traces.dump(str(tmp_path))
+    finally:
+        traces.uninstall()
+        traces.close()
+        mca_param.params.unset("runtime", "coll_segment")
+
+    assert len(paths) == nranks
+    evs = merge_traces(paths)["traceEvents"]
+    spans = [e for e in evs if e["name"] == "coll"]
+    tokens = {e["args"]["event_id"] for e in spans}
+    assert len(tokens) == 1, tokens  # same cid token on every rank
+    for rank in range(nranks):
+        mine = [e for e in spans if e["pid"] == rank]
+        assert [e["ph"] for e in sorted(mine, key=lambda e: e["ts"])] \
+            == ["B", "E"], (rank, mine)
+        b = next(e for e in mine if e["ph"] == "B")
+        assert b["args"]["info"] == payload.nbytes
+    segs = [e for e in evs if e["name"] == "coll_seg"]
+    assert segs, "no coll_seg instants recorded"
+    assert all(e["args"]["event_id"] in tokens for e in segs)
+    # the chunk train really was segmented: distinct indices, both ranks
+    for rank in range(nranks):
+        idx = {e["args"]["info"] for e in segs if e["pid"] == rank}
+        assert len(idx) > 1, (rank, idx)
+
+
+def test_coll_metrics_prometheus_and_sde_gauges():
+    """After one collective, the health plane reports it: ``coll`` block
+    in context_status, ``parsec_coll_*`` series in the Prometheus text,
+    and live PARSEC::COLL::* SDE gauges — all without a scrape ever
+    instantiating comm machinery on a coll-less context."""
+    from parsec_tpu import Context
+    from parsec_tpu.profiling import sde
+    from parsec_tpu.profiling.health import (
+        context_status, prometheus_text, register_context_gauges)
+
+    nranks = 2
+    fab = InprocFabric(nranks)
+    engines = fab.endpoints()
+    ctxs = [Context(nb_cores=1, rank=r, nranks=nranks, comm=engines[r])
+            for r in range(nranks)]
+    unregister = register_context_gauges(ctxs[0])
+    try:
+        # before any collective: no manager, no "coll" block, gauges 0
+        assert context_status(ctxs[0])["coll"] is None \
+            or context_status(ctxs[0])["coll"]["ops_started"] == 0
+        assert sde.read(sde.COLL_OPS_DONE) == 0.0
+
+        def go(r, ce):
+            h = ce.coll_allreduce(np.arange(256.0) * (r + 1))
+            assert h.wait(timeout=30)
+
+        _run_all(engines, go)
+
+        doc = context_status(ctxs[0])
+        assert doc["coll"]["ops_done"] == 1
+        assert doc["coll"]["segments_inflight"] == 0
+        assert doc["coll"]["bytes"] > 0
+        text = prometheus_text(ctxs[0])
+        assert "parsec_coll_ops_started_total" in text
+        assert 'parsec_coll_ops_done_total{rank="0"} 1' in text
+        assert "parsec_coll_segments_total" in text
+        assert 'parsec_coll_segments_inflight{rank="0"} 0' in text
+        assert sde.read(sde.COLL_OPS_DONE) == 1.0
+        assert sde.read(sde.COLL_BYTES) > 0
+        assert sde.read(sde.COLL_SEGMENTS_INFLIGHT) == 0.0
+    finally:
+        unregister()
+        for c in ctxs:
+            c.fini()
+
+
+def test_wedged_collective_diagnosed_obs007():
+    """A collective whose peer never joins must show up in a stall
+    diagnosis: OBS007 naming the op kind, cid, and step position (the
+    watchdog's findings builder reads CollManager.ops_in_flight)."""
+    from parsec_tpu import Context
+    from parsec_tpu.profiling.health import Watchdog
+
+    nranks = 2
+    fab = InprocFabric(nranks)
+    engines = fab.endpoints()
+    ctxs = [Context(nb_cores=1, rank=r, nranks=nranks, comm=engines[r])
+            for r in range(nranks)]
+    try:
+        # rank 0 starts an allreduce; rank 1 NEVER joins -> wedged at
+        # ring step 0 (rank 0's advert parks at rank 1's endpoint)
+        h = engines[0].coll.allreduce(np.arange(64.0), cid=("wedge",))
+        assert not h.wait(timeout=0.2)
+
+        wd = Watchdog(ctxs[0], window=3600.0, poll=3600.0)
+        try:
+            rep = wd.diagnose()
+        finally:
+            wd.stop()
+        codes = {f.code for f in rep.findings}
+        assert "OBS007" in codes, codes
+        msg = next(f for f in rep.findings if f.code == "OBS007").message
+        assert "allreduce[ring]" in msg and "wedge" in msg, msg
+        assert "step 0/" in msg, msg
+
+        # unwedge: rank 1 joins late; the parked advert replays at bind
+        def join():
+            hj = engines[1].coll.allreduce(np.arange(64.0) * 2,
+                                           cid=("wedge",))
+            assert hj.wait(timeout=30)
+
+        t = threading.Thread(target=join)
+        t.start()
+        assert h.wait(timeout=30)
+        t.join(timeout=30)
+        np.testing.assert_array_equal(h.result(), np.arange(64.0) * 3)
+        # post-completion: nothing in flight, a fresh diagnosis is clean
+        assert engines[0].coll.ops_in_flight() == []
+        wd2 = Watchdog(ctxs[0], window=3600.0, poll=3600.0)
+        try:
+            assert "OBS007" not in {f.code for f in
+                                    wd2.diagnose().findings}
+        finally:
+            wd2.stop()
+    finally:
+        for c in ctxs:
+            c.fini()

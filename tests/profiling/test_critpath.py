@@ -109,6 +109,42 @@ def test_critpath_compile_bucket():
     assert "compile" in critpath.render(critpath.analyze(evs))
 
 
+def test_critpath_coll_bucket():
+    """Runtime-collective spans (``coll`` from comm/coll.py, paired by
+    the deterministic cid token in ``event_id``) are their own
+    attribution bucket: chain gap under a collective is wire-collective
+    time, not host gap — and the comm > coll > compile precedence never
+    attributes a microsecond twice."""
+    evs = golden_events()
+    # a coll span covering [255, 295]: 40 us of the B->C gap
+    evs += _span("coll", 0, 255, 295, tok=77, tid="issuer")
+    rep = critpath.analyze(evs)
+    b = rep["buckets"]
+    assert b["coll_us"] == pytest.approx(40.0)
+    assert b["comm_us"] == pytest.approx(30.0)
+    assert b["host_gap_us"] == pytest.approx(30.0)  # 70 - 40
+    assert rep["coverage"] == pytest.approx(1.0)
+    assert rep["per_class"]["update"]["coll_us"] == pytest.approx(40.0)
+    assert rep["chain"][2]["gap_coll_us"] == pytest.approx(40.0)
+    # B on the issuing thread, E on a comm callback thread: still pairs
+    evs2 = golden_events()
+    evs2 += [{"name": "coll", "ph": "B", "ts": 260.0, "pid": 0,
+              "tid": "w0", "args": {"event_id": 9}},
+             {"name": "coll", "ph": "E", "ts": 290.0, "pid": 0,
+              "tid": "comm", "args": {"event_id": 9}}]
+    assert critpath.analyze(evs2)["buckets"]["coll_us"] \
+        == pytest.approx(30.0)
+    # comm+coll double-covering one window: coll gets what comm left
+    evs3 = golden_events()
+    evs3 += _span("coll", 0, 100, 140, tok=5, tid="issuer")  # vs ce_recv
+    b3 = critpath.analyze(evs3)["buckets"]
+    assert b3["comm_us"] == pytest.approx(30.0)
+    assert b3["coll_us"] == pytest.approx(20.0)
+    assert b3["comm_us"] + b3["coll_us"] + b3["host_gap_us"] \
+        == pytest.approx(100.0)
+    assert "coll" in critpath.render(rep)
+
+
 @pytest.mark.skipif(
     not __import__("parsec_tpu").native.available(),
     reason="binary tracer needs the native core")

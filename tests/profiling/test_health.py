@@ -172,6 +172,9 @@ def test_sde_doc_drift_after_dpotrf(clean_sde):
     assert {sde.COMPILE_CACHE_HITS, sde.COMPILE_CACHE_MISSES,
             sde.COMPILE_CACHE_BYTES, sde.COMPILE_BCAST_SENT,
             sde.COMPILE_BCAST_RECV} <= documented
+    # ...and so must the runtime-collective gauge set (PR 8)
+    assert {sde.COLL_OPS_STARTED, sde.COLL_OPS_DONE, sde.COLL_BYTES,
+            sde.COLL_SEGMENTS_INFLIGHT} <= documented
 
     n, nb = 64, 16
     rng = np.random.default_rng(5)

@@ -144,6 +144,112 @@ def test_failed_get_fails_pool_fast():
         mca_param.params.unset("runtime", "comm_short_limit")
 
 
+@pytest.mark.parametrize("topo", ["star", "chain", "binomial"])
+@pytest.mark.parametrize("seed", [11, 23])
+def test_broadcast_topology_random_destinations_parity(topo, seed):
+    """PR-8 satellite pin: for RANDOM destination subsets at 8 virtual
+    ranks, every topology delivers exactly once to every destination —
+    one activation received per destination, none anywhere else, the
+    payload value seen exactly once — and every forwarded activation
+    inherits the completing task's priority (a forwarding receiver must
+    not deprioritize the rest of the tree)."""
+    import threading
+
+    from parsec_tpu import Context
+    from parsec_tpu.comm.engine import TAG_ACTIVATE
+    from parsec_tpu.comm.inproc import InprocFabric
+
+    nranks = 8
+    prio = 7
+    rng = np.random.default_rng(seed)
+    dests = sorted(rng.choice(np.arange(1, nranks), size=5,
+                              replace=False).tolist())
+    nd = len(dests)
+    mca_param.set_param("runtime", "comm_short_limit", 64)
+    mca_param.set_param("runtime", "bcast_topo", topo)
+    try:
+        fabric = InprocFabric(nranks)
+        ces = fabric.endpoints()
+        # spy BEFORE any context runs: (sender rank, priority) of every
+        # activation on the wire, root sends and forwards alike
+        sent = []
+        sent_lock = threading.Lock()
+        for ce in ces:
+            orig = ce.send_am
+
+            def spy(tag, dst, payload, *, priority=0, _ce=ce, _orig=orig,
+                    **kw):
+                if tag == TAG_ACTIVATE:
+                    with sent_lock:
+                        sent.append((_ce.rank, priority))
+                return _orig(tag, dst, payload, priority=priority, **kw)
+
+            ce.send_am = spy
+        ctxs = [Context(nb_cores=2, rank=r, nranks=nranks, comm=ces[r])
+                for r in range(nranks)]
+        got = {r: [] for r in range(nranks)}
+
+        def build(rank, ctx):
+            dc = LocalCollection("D", shape=(256,), nodes=nranks,
+                                 myrank=rank,
+                                 init=lambda k: np.full(256, 7.0))
+            # D(0) is the source tile on rank 0; D(1+i) places sink(i)
+            # on the i-th random destination
+            dc.rank_of = lambda *key: 0 if key[0] == 0 \
+                else dests[key[0] - 1]
+
+            ptg = PTG("bcast_rand")
+            src = ptg.task_class("src")
+            src.affinity("D(0)")
+            src.priority(str(prio))
+            src.flow("X", INOUT, "<- D(0)", "-> X sink(0 .. ND-1)")
+            src.body(cpu=lambda X: X.__iadd__(35.0))
+            sink = ptg.task_class("sink", r="0 .. ND-1")
+            sink.affinity("D(r+1)")
+            sink.flow("X", IN, "<- X src()")
+            sink.body(cpu=lambda X, r: got[rank].append(float(X[0])))
+            return ptg.taskpool(ND=nd, D=dc)
+
+        results = {}
+
+        def worker(r):
+            tp = build(r, ctxs[r])
+            ctxs[r].add_taskpool(tp)
+            results[r] = tp.wait(timeout=60)
+
+        threads = [threading.Thread(target=worker, args=(r,))
+                   for r in range(nranks)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=90)
+        assert all(results[r] for r in range(nranks)), results
+
+        # exactly-once delivery: each destination saw the value once...
+        for r in range(nranks):
+            want = [42.0] * dests.count(r)
+            assert got[r] == want, (topo, r, dests, got)
+        rds = [c.comm.remote_dep for c in ctxs]
+        # ...via exactly one received activation; silence elsewhere
+        for r in range(nranks):
+            exp = 1 if r in dests else 0
+            assert rds[r].stats["activations_recv"] == exp, \
+                (topo, r, dict(rds[r].stats))
+        assert sum(rd.stats["activations_sent"] for rd in rds) == nd
+        # forwards engage off-star and inherit the task's priority
+        fwd = sum(rd.stats["forwarded"] for rd in rds)
+        assert (fwd == 0) if topo == "star" else (fwd > 0), (topo, fwd)
+        assert len(sent) == nd, sent
+        assert all(p == prio for _r, p in sent), (topo, sent)
+        if topo != "star":
+            assert any(r != 0 for r, _p in sent), (topo, sent)
+        for c in ctxs:
+            c.fini()
+    finally:
+        mca_param.params.unset("runtime", "comm_short_limit")
+        mca_param.params.unset("runtime", "bcast_topo")
+
+
 @pytest.mark.parametrize("topo,root_sends,root_gets", [
     ("star", 7, 7),
     ("chain", 1, 1),

@@ -1,0 +1,381 @@
+"""Runtime collectives (comm/coll.py): ring / recursive-doubling /
+gather allreduce, reduce-scatter, allgather, binomial bcast, and the
+memory-bounded redistribution rounds — all on the 8-rank inproc fabric
+(tier-1 fast + deterministic; TCP parity is pinned by the ``coll``
+scenario in test_tcp.py over real sockets).
+
+The collectives ride the PR-4 rendezvous machinery: segments move as
+chunked one-sided pulls into ONE preallocated BytePool slot per op, so
+these tests also pin the endpoint bookkeeping (staging registrations
+reclaimed, budget accounting, stats)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm import CollError
+from parsec_tpu.comm.inproc import InprocFabric
+from parsec_tpu.utils import mca_param
+
+N = 8
+
+
+def _fabric(n=N):
+    fab = InprocFabric(n)
+    engines = fab.endpoints()
+    for e in engines:
+        _ = e.coll  # register the ctl op before any advert can arrive
+    return fab, engines
+
+
+def _run_all(engines, fn, ranks=None):
+    """Run fn(rank, engine) on one thread per rank; return results,
+    re-raising the first failure."""
+    ranks = list(ranks if ranks is not None else range(len(engines)))
+    out = {}
+    errs = []
+
+    def worker(r):
+        try:
+            out[r] = fn(r, engines[r])
+        except Exception as e:
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in ranks]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert all(not t.is_alive() for t in ts), "collective wedged"
+    if errs:
+        raise errs[0][1]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# allreduce: every algorithm, every rank gets the same right answer
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("algo", ["ring", "rd", "gather"])
+def test_allreduce_parity_all_algorithms(algo):
+    _, engines = _fabric()
+    ref = sum(np.arange(40, dtype=np.float64) * (r + 1) for r in range(N))
+
+    def go(r, ce):
+        h = ce.coll_allreduce(np.arange(40, dtype=np.float64) * (r + 1),
+                              algo=algo)
+        assert h.wait(timeout=30)
+        return np.array(h.result())
+
+    out = _run_all(engines, go)
+    for r in range(N):
+        np.testing.assert_array_equal(out[r], ref)
+    # endpoint bookkeeping: every op retired, nothing in flight, every
+    # staging registration reclaimed (fabric mem table empty)
+    for ce in engines:
+        s = ce.coll.summary()
+        assert s["ops_done"] == s["ops_started"] == 1
+        assert s["ops_inflight"] == 0 and s["segments_inflight"] == 0
+        assert not ce.fabric.mem, ce.fabric.mem
+
+
+@pytest.mark.parametrize("op,fn", [
+    ("sum", np.sum), ("max", np.max), ("min", np.min), ("prod", np.prod),
+])
+def test_allreduce_reduction_ops(op, fn):
+    _, engines = _fabric(4)
+    contribs = [np.array([2.0, 3.0, 5.0]) + r for r in range(4)]
+    ref = fn(np.stack(contribs), axis=0)
+
+    def go(r, ce):
+        h = ce.coll_allreduce(contribs[r].copy(), op=op)
+        assert h.wait(timeout=30)
+        return np.array(h.result())
+
+    out = _run_all(engines, go)
+    for r in range(4):
+        np.testing.assert_array_equal(out[r], ref)
+
+
+def test_allreduce_2d_and_nondividing_sizes():
+    """Shapes that don't divide by the group size partition unevenly
+    (trailing blocks smaller/empty) and still reduce exactly."""
+    _, engines = _fabric()
+    for shape in [(3,), (5, 7), (1,), (13,)]:
+        ref = sum(np.full(shape, float(r + 1)) for r in range(N))
+
+        def go(r, ce, shape=shape):
+            h = ce.coll_allreduce(np.full(shape, float(r + 1)))
+            assert h.wait(timeout=30)
+            return np.array(h.result())
+
+        out = _run_all(engines, go)
+        for r in range(N):
+            np.testing.assert_array_equal(out[r], ref)
+
+
+def test_allreduce_group_subset():
+    """Collectives over a strict subset of the mesh leave the other
+    ranks untouched."""
+    _, engines = _fabric()
+    group = [1, 3, 5, 7]
+    ref = sum(np.arange(8.0) + r for r in group)
+
+    def go(r, ce):
+        h = ce.coll_allreduce(np.arange(8.0) + r, group=group)
+        assert h.wait(timeout=30)
+        return np.array(h.result())
+
+    out = _run_all(engines, go, ranks=group)
+    for r in group:
+        np.testing.assert_array_equal(out[r], ref)
+    for r in (0, 2, 4, 6):
+        assert engines[r].coll.summary()["ops_started"] == 0
+
+
+def test_allreduce_many_segments_pipeline():
+    """A payload much larger than the segment size moves as a pipelined
+    chunk train (window = comm_pipeline_depth) landing out of order into
+    the one pool slot."""
+    mca_param.set_param("runtime", "coll_segment", 128)
+    try:
+        _, engines = _fabric(4)
+        for ce in engines:
+            assert ce.coll.segment == 128
+        payload = np.arange(4096, dtype=np.float64)  # 32 KiB: 256 chunks
+        ref = payload * sum(range(1, 5))
+
+        def go(r, ce):
+            h = ce.coll_allreduce(payload * (r + 1))
+            assert h.wait(timeout=60)
+            return np.array(h.result())
+
+        out = _run_all(engines, go)
+        for r in range(4):
+            np.testing.assert_array_equal(out[r], ref)
+        # the train really was chunked
+        assert engines[0].coll.stats["seg_done"] > 10
+    finally:
+        mca_param.params.unset("runtime", "coll_segment")
+
+
+def test_allreduce_device_arrays_jit_reduce():
+    """jax.Array contributions reduce through the jitted combiner (host
+    fallback stays correct if jit fails, but on CPU it must engage)."""
+    import jax.numpy as jnp
+
+    _, engines = _fabric(4)
+    ref = sum(np.arange(16, dtype=np.float32) + r for r in range(4))
+
+    def go(r, ce):
+        h = ce.coll_allreduce(jnp.arange(16, dtype=jnp.float32) + r)
+        assert h.wait(timeout=30)
+        return np.array(h.result())
+
+    out = _run_all(engines, go)
+    for r in range(4):
+        np.testing.assert_allclose(out[r], ref)
+    assert sum(ce.coll.stats["jit_reduces"] for ce in engines) > 0
+
+
+def test_single_rank_and_empty_are_immediate():
+    _, engines = _fabric(1)
+    h = engines[0].coll_allreduce(np.arange(4.0))
+    assert h.done and h.wait(timeout=1)
+    np.testing.assert_array_equal(h.result(), np.arange(4.0))
+
+    _, engines = _fabric(2)
+
+    def go(r, ce):
+        h = ce.coll_allreduce(np.zeros(0))
+        assert h.wait(timeout=5)
+        return h.result()
+
+    out = _run_all(engines, go)
+    assert out[0].size == 0 and out[1].size == 0
+
+
+# ---------------------------------------------------------------------------
+# reduce-scatter / allgather / bcast
+# ---------------------------------------------------------------------------
+
+def test_reduce_scatter_partitions():
+    _, engines = _fabric()
+    full = sum(np.arange(36, dtype=np.float64) * (r + 1) for r in range(N))
+
+    def go(r, ce):
+        h = ce.coll_reduce_scatter(np.arange(36, dtype=np.float64)
+                                   * (r + 1))
+        assert h.wait(timeout=30)
+        return np.array(h.result())
+
+    out = _run_all(engines, go)
+    for r in range(N):  # 36 elements over 8 ranks: ragged partitions
+        b0, b1 = r * 36 // N, (r + 1) * 36 // N
+        np.testing.assert_array_equal(out[r], full[b0:b1])
+
+
+def test_allgather_rank_order():
+    _, engines = _fabric()
+
+    def go(r, ce):
+        h = ce.coll_allgather(np.full((2, 3), float(r)))
+        assert h.wait(timeout=30)
+        return np.array(h.result())
+
+    out = _run_all(engines, go)
+    exp = np.concatenate([np.full((2, 3), float(r)) for r in range(N)])
+    for r in range(N):
+        np.testing.assert_array_equal(out[r], exp)
+
+
+def test_allgather_unequal_contribution_fails_loudly():
+    """A rank bringing the wrong shape fails the collective on EVERY
+    rank with a CollError (advert mismatch at whichever ring step first
+    sees the skewed partition) — never a hang, never silent
+    corruption."""
+    _, engines = _fabric(4)
+
+    def go(r, ce):
+        size = 8 if r != 2 else 6  # rank 2 brings the wrong shape
+        try:
+            h = ce.coll_allgather(np.zeros(size))
+            h.wait(timeout=10)
+            return "ok"
+        except CollError as e:
+            return str(e)
+
+    out = _run_all(engines, go)
+    for r in range(4):
+        assert out[r] != "ok" and "mismatch" in out[r], (r, out[r])
+
+
+@pytest.mark.parametrize("root", [0, 3])
+def test_bcast_binomial(root):
+    _, engines = _fabric()
+    data = np.arange(100, dtype=np.float64) * 2.5
+
+    def go(r, ce):
+        arr = data.copy() if r == root else np.zeros_like(data)
+        h = ce.coll_bcast(arr, root=root)
+        assert h.wait(timeout=30)
+        return np.array(h.result())
+
+    out = _run_all(engines, go)
+    for r in range(N):
+        np.testing.assert_array_equal(out[r], data)
+    # binomial: the root stages to at most ceil(log2 N) children
+    assert engines[root].coll.stats["blocks_sent"] <= 3
+
+
+# ---------------------------------------------------------------------------
+# discipline: ordering, parking, errors, priority
+# ---------------------------------------------------------------------------
+
+def test_late_joiner_messages_park():
+    """Rank 1 joins the collective long after rank 0's adverts arrived:
+    they park at the manager and replay at bind (no drops, no hangs)."""
+    import time
+
+    _, engines = _fabric(2)
+    ref = np.arange(6.0) * 3
+
+    def go(r, ce):
+        if r == 1:
+            time.sleep(0.3)  # rank 0's advert lands before our bind
+            # drain what arrived while we were away
+            ce.progress_nonblocking()
+        h = ce.coll_allreduce(np.arange(6.0) * (r + 1), algo="rd")
+        assert h.wait(timeout=30)
+        return np.array(h.result())
+
+    out = _run_all(engines, go)
+    np.testing.assert_array_equal(out[0], ref)
+    np.testing.assert_array_equal(out[1], ref)
+
+
+def test_same_cid_reuse_refused():
+    _, engines = _fabric(2)
+    h = engines[0].coll.allreduce(np.arange(4.0), cid=("x",))
+    with pytest.raises(CollError, match="already in flight"):
+        engines[0].coll.allreduce(np.arange(4.0), cid=("x",))
+    # fail it so the endpoint unbinds (peer 1 never joins this one)
+    h._fail("test teardown", notify_peers=False)
+
+
+def test_peer_failure_propagates():
+    """A rank that fails its op notifies the group: every peer's wait()
+    raises CollError naming the origin rather than timing out."""
+    _, engines = _fabric(4)
+
+    def go(r, ce):
+        h = ce.coll.allreduce(np.arange(8.0), cid=("f",))
+        if r == 2:
+            h._fail("synthetic wreck")
+            return "failed"
+        try:
+            h.wait(timeout=20)
+            return "ok"
+        except CollError as e:
+            return str(e)
+
+    out = _run_all(engines, go)
+    # every peer failed NAMING rank 2 — either via the err notification
+    # ("peer rank 2: synthetic wreck") or, if its chunk pull raced the
+    # wrecked rank's staging teardown, via the failed pull ("segment
+    # pull ... from rank 2 failed"); never a timeout
+    for r in (0, 1, 3):
+        assert "rank 2" in out[r], (r, out[r])
+    assert any("synthetic wreck" in out[r] for r in (0, 1, 3)), out
+
+
+def test_unknown_reduction_op_rejected():
+    _, engines = _fabric(2)
+    with pytest.raises(CollError, match="unknown reduction op"):
+        engines[0].coll.allreduce(np.arange(4.0), op="xor")
+
+
+def test_rank_outside_group_rejected():
+    _, engines = _fabric(4)
+    with pytest.raises(CollError, match="not in collective group"):
+        engines[0].coll.allreduce(np.arange(4.0), group=[1, 2])
+
+
+def test_collective_sends_ride_below_activations():
+    """Default collective priority is -1: every control/data message the
+    op emits sorts BELOW dependency activations (priority 0+) in a
+    coalesced frame, so bulk collectives never starve the critical
+    path."""
+    _, engines = _fabric(2)
+    prios = []
+    orig = engines[0].send_am
+
+    def spy(tag, dst, payload, priority=0, **kw):
+        prios.append(priority)
+        return orig(tag, dst, payload, priority=priority, **kw)
+
+    engines[0].send_am = spy
+
+    def go(r, ce):
+        h = ce.coll_allreduce(np.arange(64.0) + r)
+        assert h.wait(timeout=30)
+
+    _run_all(engines, go)
+    assert prios, "rank 0 sent no collective messages?"
+    assert all(p == -1 for p in prios), prios
+
+
+def test_rd_non_power_of_two_falls_back_to_ring():
+    _, engines = _fabric(3)
+    ref = sum(np.arange(10.0) + r for r in range(3))
+
+    def go(r, ce):
+        h = ce.coll_allreduce(np.arange(10.0) + r, algo="rd")
+        assert h.wait(timeout=30)
+        return np.array(h.result()), h.state()
+
+    out = _run_all(engines, go)
+    for r in range(3):
+        np.testing.assert_array_equal(out[r][0], ref)
+        assert "[ring]" in out[r][1]  # the fallback really engaged

@@ -162,3 +162,12 @@ def test_tcp_dtt_pingpong_mixed_layouts(nb, kinds):
     # payloads each (the scenario already pinned its own side exactly)
     assert all(o["pld_bytes"] == 2 * (NT - 1) * tile for o in out), out
     assert all(o["pld_kinds"] == kinds for o in out), out
+
+
+def test_tcp_collectives_4ranks():
+    """Runtime collectives over real sockets: allreduce (chunked ring),
+    reduce-scatter, allgather, bcast — the TCP side of the inproc parity
+    the coll endpoint promises (tests/runtime/test_coll.py)."""
+    out = run_scenario("coll", 4, timeout=300)
+    assert all(o["ops"] == 4 for o in out)
+    assert all(o["segs"] > 0 for o in out)
