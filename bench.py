@@ -544,12 +544,135 @@ def _rest_of_main(N, NB, dtype, backend, on_accel, reps, rtt,
             and not _over_budget(0.93, "redistribute stage"):
         _leg(fields, "redistribute", lambda: redistribute_leg(fields))
 
+    # ---- STAGE 3g: multi-tenant serving (round-11 tentpole) ------------
+    # K concurrent small jobs riding alongside one big dpotrf on a
+    # RuntimeService: aggregate tasks/s plus p50/p95 small-job latency
+    # WITH the wdrr fairness scheduler vs WITHOUT (default scheduler,
+    # small jobs behind the big backlog), against the solo latency.
+    if os.environ.get("BENCH_SERVE", "1") != "0" \
+            and not _over_budget(0.94, "multi_tenant stage"):
+        _leg(fields, "multi_tenant", lambda: multi_tenant_leg(fields))
+
     # ---- STAGE 4: QR / LU through the runtime --------------------------
     if on_accel and os.environ.get("BENCH_QRLU", "1") != "0" \
             and not _over_budget(0.80, "qr/lu stage"):
         qrlu_stage(int(os.environ.get("BENCH_QRLU_N", "8192")),
                    int(os.environ.get("BENCH_QRLU_NB", "512")),
                    measure, fields)
+
+
+def multi_tenant_leg(fields: dict) -> None:
+    """Serving-plane A/B: K small chain jobs submitted while one big
+    CPU-body dpotrf runs on a RuntimeService, fairness (wdrr) ON vs
+    OFF.  Reports aggregate tasks/s and small-job p50/p95 latency per
+    arm plus the solo small-job latency; the acceptance floor (p95
+    with fairness <= 5x solo, vs the unbounded starvation the OFF arm
+    shows) asserts under PARSEC_TPU_PERF_ASSERTS."""
+    import numpy as np
+
+    from parsec_tpu.data import LocalCollection
+    from parsec_tpu.datadist import TiledMatrix
+    from parsec_tpu.dsl.ptg import PTG
+    from parsec_tpu.core.lifecycle import AccessMode
+    from parsec_tpu.ops.cholesky import cholesky_ptg
+    from parsec_tpu.serve import RuntimeService
+
+    N = int(os.environ.get("BENCH_SERVE_N", "1024"))
+    NB = int(os.environ.get("BENCH_SERVE_NB", "32"))
+    K = int(os.environ.get("BENCH_SERVE_SMALL", "12"))
+    SMALL_N = 16
+    cores = min(os.cpu_count() or 2, 4)
+    rng = np.random.default_rng(5)
+    M = rng.standard_normal((N, N))
+    SPD = M @ M.T + N * np.eye(N)
+    big_tasks = _dpotrf_ntasks(N, NB)
+
+    def big_tp():
+        A = TiledMatrix(N, N, NB, NB, name="serveA")
+        A.from_array(SPD)
+        return cholesky_ptg(use_tpu=False).taskpool(NT=A.mt, A=A)
+
+    def small_tp(tag):
+        dc = LocalCollection(f"S{tag}", shape=(1,),
+                             init=lambda k: np.zeros(4))
+        ptg = PTG(f"small{tag}")
+        step = ptg.task_class("step", k="0 .. N-1")
+        step.affinity("S(0)")
+        step.flow("X", AccessMode.INOUT,
+                  "<- (k == 0) ? S(0) : X step(k-1)",
+                  "-> (k < N-1) ? X step(k+1) : S(0)")
+        step.body(cpu=lambda X, k: X.__iadd__(1.0))
+        return ptg.taskpool(N=SMALL_N, S=dc)
+
+    def pctl(xs, q):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))]
+
+    # solo latency: the small job on an otherwise idle service
+    with RuntimeService(nb_cores=cores) as sv:
+        solo = []
+        for i in range(3):
+            h = sv.submit("online", small_tp(f"solo{i}"))
+            assert h.wait(timeout=60)
+            solo.append(h.latency_s)
+    solo_lat = sorted(solo)[len(solo) // 2]
+    fields["multi_tenant_solo_ms"] = round(solo_lat * 1e3, 3)
+
+    # the adversarial shape: the batch tenant submits at a HIGHER job
+    # priority (a production bully).  Without fairness the composed
+    # priority is absolute — strict-priority pops (spq) serve the big
+    # backlog first and small jobs wait for its serialization gaps;
+    # wdrr bounds that wait to the deficit round.  Where a small
+    # submission lands relative to those gaps is schedule noise, so
+    # each arm runs BENCH_SERVE_REPS fresh services and the quoted
+    # numbers are medians (the round-6 discipline; per-rep arrays kept)
+    reps = max(1, int(os.environ.get("BENCH_SERVE_REPS", "3")))
+    for arm, fairness, sched in (("fair", True, None),
+                                 ("nofair", False, "spq")):
+        per_rep = {"tasks_per_s": [], "p50_ms": [], "p95_ms": []}
+        for _rep in range(reps):
+            with RuntimeService(nb_cores=cores, fairness=fairness,
+                                scheduler=sched) as sv:
+                tp = big_tp()
+                t0 = time.perf_counter()
+                big = sv.submit("batch", tp, priority=8)
+                deadline = time.monotonic() + 120
+                while tp.nb_retired < 50:  # big job genuinely flowing
+                    if time.monotonic() > deadline:
+                        raise RuntimeError("big job never started")
+                    time.sleep(0.002)
+                lats = []
+                for i in range(K):
+                    h = sv.submit("online", small_tp(f"{arm}{_rep}_{i}"))
+                    assert h.wait(timeout=600), h.status()
+                    lats.append(h.latency_s)
+                assert big.wait(timeout=900), big.status()
+                wall = time.perf_counter() - t0
+            total = big_tasks + K * SMALL_N
+            per_rep["tasks_per_s"].append(round(total / wall, 1))
+            per_rep["p50_ms"].append(round(pctl(lats, 0.50) * 1e3, 3))
+            per_rep["p95_ms"].append(round(pctl(lats, 0.95) * 1e3, 3))
+        for key, vals in per_rep.items():
+            fields[f"multi_tenant_{key}_{arm}_reps"] = vals
+            sr = sorted(vals)
+            mid = len(sr) // 2
+            med = sr[mid] if len(sr) % 2 else (sr[mid - 1] + sr[mid]) / 2
+            fields[f"multi_tenant_{key}_{arm}"] = round(med, 3)
+    p95_fair = fields["multi_tenant_p95_ms_fair"]
+    p95_nofair = fields["multi_tenant_p95_ms_nofair"]
+    fields["multi_tenant_fairness_gain"] = round(
+        p95_nofair / max(p95_fair, 1e-9), 2)
+    print(f"multi_tenant: solo {fields['multi_tenant_solo_ms']} ms, "
+          f"p95 fair {p95_fair} ms vs nofair {p95_nofair} ms "
+          f"(gain {fields['multi_tenant_fairness_gain']}x), tasks/s "
+          f"fair {fields['multi_tenant_tasks_per_s_fair']} vs nofair "
+          f"{fields['multi_tenant_tasks_per_s_nofair']}",
+          file=sys.stderr)
+    if os.environ.get("PARSEC_TPU_PERF_ASSERTS", "1") != "0":
+        bound = max(5 * fields["multi_tenant_solo_ms"], 250.0)
+        assert p95_fair <= bound, (
+            f"multi_tenant floor: p95 with fairness {p95_fair} ms > "
+            f"{bound} ms (5x solo) — wdrr is not protecting small jobs")
 
 
 def comm_wire_leg(fields: dict) -> None:

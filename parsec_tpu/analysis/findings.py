@@ -104,6 +104,12 @@ CODES = {
                         "rank never joined, or its segments stopped "
                         "landing) — the finding names the op and its "
                         "step position"),
+    "OBS008": (ERROR, "tenant job stalled: a serving-plane taskpool "
+                      "stopped progressing — the finding names the "
+                      "tenant, the job, and its retired/known position, "
+                      "so the operator knows WHOSE workload is wedged "
+                      "(and which client to page) before reading the "
+                      "protocol-level findings"),
 }
 
 

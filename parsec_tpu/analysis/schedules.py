@@ -244,8 +244,9 @@ def explore(
     on_seed_done: Optional[Callable[[int], None]] = None,
 ) -> ExplorationResult:
     """Run ``build`` (the :func:`parsec_tpu.multirank.run_multirank_perf`
-    shape: ``build(rank, ctx) -> (taskpool, user)``) once per seed under
-    that seed's perturbations.
+    shape: ``build(rank, ctx) -> (taskpool, user)``; a LIST of taskpools
+    runs them co-resident on the rank's context — the multi-tenant
+    serving shape) once per seed under that seed's perturbations.
 
     ``snapshot(users) -> digest`` defines cross-seed identity (default:
     :func:`tile_digest` of every user object).  With ``assert_clean``
@@ -280,9 +281,27 @@ def explore(
 
             def worker(r):
                 try:
-                    tp, users[r] = build(r, ctxs[r])
-                    ctxs[r].add_taskpool(tp)
-                    oks[r] = tp.wait(timeout=timeout)
+                    # build may return ONE taskpool or a list of
+                    # co-resident pools (the multi-tenant serving shape:
+                    # several heterogeneous DAGs on one context at once)
+                    tps, users[r] = build(r, ctxs[r])
+                    if isinstance(tps, (list, tuple)):
+                        for tp in tps:
+                            ctxs[r].add_taskpool(tp)
+                        # ONE shared deadline for the whole co-resident
+                        # set (they execute concurrently), and every
+                        # pool is waited even after a failure so
+                        # teardown never races a still-live pool
+                        deadline = time.monotonic() + timeout
+                        ok = True
+                        for tp in tps:
+                            rem = max(0.01,
+                                      deadline - time.monotonic())
+                            ok = tp.wait(timeout=rem) and ok
+                        oks[r] = ok
+                    else:
+                        ctxs[r].add_taskpool(tps)
+                        oks[r] = tps.wait(timeout=timeout)
                 except BaseException as e:
                     errs.append((r, e))
 

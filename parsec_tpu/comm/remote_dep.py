@@ -80,6 +80,25 @@ def _fail_pool(tp, why: str) -> bool:
     return True
 
 
+def fail_pool_for_context(ctx, tp, why: str) -> bool:
+    """Fail one pool through the path its context warrants: broadcast
+    the abort to peer ranks on a multi-rank mesh (healthy peers must
+    not block to their full timeout), plain local fail otherwise.  The
+    single dispatch the worker error path, the strict watchdog and the
+    serving plane's cancel/evict all share."""
+    if getattr(tp, "fail_reason", None) is None:
+        try:
+            tp.fail_reason = why
+        except Exception:
+            pass
+    rd = getattr(ctx.comm, "remote_dep", None) \
+        if getattr(ctx, "comm", None) is not None else None
+    if getattr(ctx, "nranks", 1) > 1 and rd is not None:
+        rd._fail_pool_everywhere(tp, why)
+        return tp.failed
+    return _fail_pool(tp, why)
+
+
 def _wire_len(msg: dict) -> int:
     """Logical activation-header length in bytes (reference
     ``remote_dep_wire_activate_t``: taskpool_id, task_class_id, locals,

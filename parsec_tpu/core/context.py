@@ -434,15 +434,10 @@ class Context:
             import traceback
 
             traceback.print_exc()
-            from ..comm.remote_dep import _fail_pool
+            from ..comm.remote_dep import fail_pool_for_context
 
             why = f"task {task!r} body raised: {type(e).__name__}: {e}"
-            rd = getattr(self.comm, "remote_dep", None) \
-                if self.comm is not None else None
-            if self.nranks > 1 and rd is not None:
-                rd._fail_pool_everywhere(task.taskpool, why)
-            else:
-                _fail_pool(task.taskpool, why)
+            fail_pool_for_context(self, task.taskpool, why)
             # incident artifacts: snapshot the flight recorder(s) so the
             # failure ships with the last N runtime events per rank
             # (no-op unless PARSEC_TPU_FLIGHT installed one; never raises)

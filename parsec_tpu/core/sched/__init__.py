@@ -7,6 +7,6 @@ steal module (``lfq``) is the default, like the reference.
 """
 
 from .base import Scheduler
-from . import lfq, gd, ap, ll, rnd, spq, more  # noqa: F401  (self-registering)
+from . import lfq, gd, ap, ll, rnd, spq, wdrr, more  # noqa: F401  (self-registering)
 
 __all__ = ["Scheduler"]

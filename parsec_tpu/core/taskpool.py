@@ -62,6 +62,18 @@ class Taskpool:
         #: Front-ends that manage counters themselves set this False.
         self.auto_count = nb_tasks is None
         self.priority: int = 0
+        #: serving-plane identity (set by ``parsec_tpu.serve`` at
+        #: admission, None outside a service): the tenant this pool
+        #: belongs to, the tenant's fairness weight, and the job-level
+        #: priority the submitter asked for.  ``priority_base`` is the
+        #: composed (tenant weight, job priority) offset added to every
+        #: task's own priority at construction (``Task.__init__``) so the
+        #: composition reaches both the scheduler pop order and the
+        #: priority-ordered remote sends without per-site plumbing.
+        self.tenant: Optional[str] = None
+        self.tenant_weight: int = 1
+        self.job_priority: int = 0
+        self.priority_base: int = 0
         self.user: Any = None
         #: tasks retired through :meth:`task_done` (the health plane's
         #: per-taskpool progress currency); guarded — retirements arrive
@@ -69,6 +81,11 @@ class Taskpool:
         self.nb_retired = 0
         self._retire_lock = threading.Lock()
         self._t_attached: Optional[float] = None
+        #: set at the terminating transition: freezes the progress()
+        #: rate window, so a finished pool's rate stops decaying while
+        #: co-resident pools keep the context alive (serving meshes run
+        #: many pools; rates must stay per-pool, not context-lifetime)
+        self._t_terminated: Optional[float] = None
 
     # -- task classes -----------------------------------------------------
     def add_task_class(self, tc: TaskClass) -> TaskClass:
@@ -105,6 +122,7 @@ class Taskpool:
             if self._terminated.is_set():
                 return False
             self.failed = True
+            self._t_terminated = time.monotonic()
             self._terminated.set()
             return True
 
@@ -115,6 +133,7 @@ class Taskpool:
                 # Context.abort): a late tdm zero-crossing must not
                 # re-fire on_complete / resume a cancelled composition
                 return
+            self._t_terminated = time.monotonic()
             self._terminated.set()
         debug.verbose(4, "core", "taskpool %s(%d) terminated", self.name, self.taskpool_id)
         if self.context is not None:
@@ -146,7 +165,14 @@ class Taskpool:
             rem = getattr(self.tdm, "_nb_tasks", None)
             if isinstance(rem, int) and rem >= 0:
                 known = retired + rem
-        elapsed = (time.monotonic() - self._t_attached) \
+        # rate window is strictly PER-POOL: attach to terminate (or to
+        # now while live).  On a serving context several pools coexist —
+        # a finished pool's rate must not decay toward zero while
+        # neighbors keep running, and a pool attached mid-run measures
+        # from its own attach, not the context's start.
+        end = self._t_terminated if self._t_terminated is not None \
+            else time.monotonic()
+        elapsed = (end - self._t_attached) \
             if self._t_attached is not None else 0.0
         rate = retired / elapsed if elapsed > 0 else 0.0
         eta = None
@@ -156,6 +182,7 @@ class Taskpool:
             "taskpool_id": self.taskpool_id,
             "name": self.name,
             "type": self.taskpool_type,
+            "tenant": self.tenant,
             "retired": retired,
             "known": known,
             "elapsed_s": round(elapsed, 6),

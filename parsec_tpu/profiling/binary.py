@@ -264,6 +264,12 @@ class RankTraceSet:
                 name = getattr(task.task_class, "name",
                                type(task).__name__)
                 tr.instant(tr.keyword(f"class:{name}"), t)
+                # serving plane: tag the token with its pool's tenant so
+                # offline tools (critpath --per-tenant table) attribute
+                # chain time to WHOSE job it was, not just which class
+                tenant = getattr(task.taskpool, "tenant", None)
+                if tenant:
+                    tr.instant(tr.keyword(f"tenant:{tenant}"), t)
         return t
 
     # -- lifecycle -------------------------------------------------------

@@ -98,6 +98,9 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
     exec_open: Dict[Tuple[Any, Any], float] = {}
     tasks: Dict[Tuple[Any, int], dict] = {}
     classes: Dict[Tuple[Any, int], str] = {}
+    #: serving-plane attribution: ``tenant:<name>`` instants map tokens
+    #: to the tenant whose job the task belonged to (profiling.binary)
+    tenants: Dict[Tuple[Any, int], str] = {}
     preds: Dict[Tuple[Any, int], List[Tuple[Any, int]]] = defaultdict(list)
     comm_open: Dict[Tuple[Any, Any, str], float] = {}
     comm_iv: Dict[Any, List[Tuple[float, float]]] = defaultdict(list)
@@ -147,6 +150,8 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
                 preds[(pid, dst)].append((pid, src))
         elif isinstance(name, str) and name.startswith("class:") and ph == "i":
             classes[(pid, args.get("event_id"))] = name[6:]
+        elif isinstance(name, str) and name.startswith("tenant:") and ph == "i":
+            tenants[(pid, args.get("event_id"))] = name[7:]
         elif name in comm_names:
             ckey = (pid, e.get("tid"), name)
             if ph == "B":
@@ -176,7 +181,8 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
              "buckets": {"compute_us": 0.0, "comm_us": 0.0,
                          "coll_us": 0.0, "compile_us": 0.0,
                          "host_gap_us": 0.0},
-             "per_class": {}, "chain": [], "comm_regimes": regimes}
+             "per_class": {}, "per_tenant": {}, "chain": [],
+             "comm_regimes": regimes}
     if not tasks:
         return empty
     comm_merged = {pid: _merge_intervals(iv) for pid, iv in comm_iv.items()}
@@ -202,6 +208,9 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
     buckets = {"compute_us": 0.0, "comm_us": 0.0, "coll_us": 0.0,
                "compile_us": 0.0, "host_gap_us": 0.0}
     per_class: Dict[str, Dict[str, float]] = defaultdict(
+        lambda: {"count": 0, "compute_us": 0.0, "comm_us": 0.0,
+                 "coll_us": 0.0, "compile_us": 0.0, "host_gap_us": 0.0})
+    per_tenant: Dict[str, Dict[str, float]] = defaultdict(
         lambda: {"count": 0, "compute_us": 0.0, "comm_us": 0.0,
                  "coll_us": 0.0, "compile_us": 0.0, "host_gap_us": 0.0})
     rows = []
@@ -238,7 +247,17 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
         pc["coll_us"] += gap_coll
         pc["compile_us"] += gap_compile
         pc["host_gap_us"] += gap - gap_comm - gap_coll - gap_compile
+        tenant = tenants.get(key)
+        if tenant is not None:
+            pt = per_tenant[tenant]
+            pt["count"] += 1
+            pt["compute_us"] += dur
+            pt["comm_us"] += gap_comm
+            pt["coll_us"] += gap_coll
+            pt["compile_us"] += gap_compile
+            pt["host_gap_us"] += gap - gap_comm - gap_coll - gap_compile
         rows.append({"token": tok, "pid": pid, "class": cls,
+                     "tenant": tenant,
                      "begin_us": t["begin"], "end_us": t["end"],
                      "gap_us": gap, "gap_comm_us": gap_comm,
                      "gap_coll_us": gap_coll,
@@ -252,6 +271,7 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
         "coverage": (attributed / wall) if wall > 0 else 0.0,
         "buckets": buckets,
         "per_class": {k: dict(v) for k, v in per_class.items()},
+        "per_tenant": {k: dict(v) for k, v in per_tenant.items()},
         "chain": rows,
         "comm_regimes": regimes,
     }
@@ -290,4 +310,14 @@ def render(report: dict) -> str:
                 f"{pc['compute_us'] / 1e3:>12.3f}"
                 f"{pc['comm_us'] / 1e3:>10.3f}"
                 f"{pc['host_gap_us'] / 1e3:>10.3f}{per_task:>14.1f}")
+    if report.get("per_tenant"):
+        lines.append(f"  {'tenant':<18}{'count':>6}{'compute_ms':>12}"
+                     f"{'comm_ms':>10}{'host_ms':>10}")
+        for ten in sorted(report["per_tenant"]):
+            pt = report["per_tenant"][ten]
+            lines.append(
+                f"  {ten:<18}{pt['count']:>6}"
+                f"{pt['compute_us'] / 1e3:>12.3f}"
+                f"{pt['comm_us'] / 1e3:>10.3f}"
+                f"{pt['host_gap_us'] / 1e3:>10.3f}")
     return "\n".join(lines)

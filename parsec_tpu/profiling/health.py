@@ -154,6 +154,10 @@ def context_status(ctx) -> Dict[str, Any]:
         "compile_cache": (None if getattr(ctx, "compile_cache", None)
                           is None else ctx.compile_cache.snapshot()),
         "watchdog": None if wd is None else wd.status(),
+        # multi-tenant serving plane (serve.RuntimeService hangs itself
+        # off ctx.serve): per-tenant jobs/retired/rate/ETA table
+        "serve": (None if getattr(ctx, "serve", None) is None
+                  else ctx.serve.status_doc()),
     }
     return doc
 
@@ -238,6 +242,23 @@ def register_context_gauges(ctx) -> Callable[[], None]:
     gauge(sde.COLL_BYTES, coll_val("bytes"))
     gauge(sde.COLL_SEGMENTS_INFLIGHT, coll_val("segments_inflight"))
 
+    # serving-plane counters (serve.RuntimeService on ctx.serve): zero
+    # until a service attaches — registered unconditionally so external
+    # monitors can alert on them before the first job arrives
+    def serve_val(key: str):
+        def get() -> float:
+            sv = getattr(ctx, "serve", None)
+            if sv is None:
+                return 0.0
+            return sv.counters().get(key, 0.0)
+        return get
+
+    gauge(sde.SERVE_JOBS_QUEUED, serve_val("queued"))
+    gauge(sde.SERVE_JOBS_INFLIGHT, serve_val("inflight"))
+    gauge(sde.SERVE_JOBS_DONE, serve_val("done"))
+    gauge(sde.SERVE_JOBS_REJECTED, serve_val("rejected"))
+    gauge(sde.SERVE_TENANTS, serve_val("tenants"))
+
     # lets context_status/prometheus_text skip this context's own gauges
     # (exported under first-class names) instead of sampling them twice
     ctx._sde_gauge_names = tuple(names)
@@ -305,6 +326,8 @@ def prometheus_text(ctx) -> str:
     out.append("# TYPE parsec_taskpool_retired_total counter")
     for p in doc["taskpools"]:
         lab = {**r, "taskpool": p["taskpool_id"], "name": p["name"]}
+        if p.get("tenant"):
+            lab["tenant"] = p["tenant"]
         _line(out, "parsec_taskpool_retired_total", lab, p["retired"])
         if p["known"] is not None:
             _line(out, "parsec_taskpool_known_tasks", lab, p["known"])
@@ -368,6 +391,36 @@ def prometheus_text(ctx) -> str:
         _line(out, "parsec_coll_segments_inflight", r,
               co.get("segments_inflight", 0))
         _line(out, "parsec_coll_ops_inflight", r, co.get("ops_inflight", 0))
+
+    sv = doc.get("serve")
+    if sv is not None:
+        j = sv["jobs"]
+        out.append("# TYPE parsec_serve_jobs_queued gauge")
+        _line(out, "parsec_serve_jobs_queued", r, j["queued"])
+        _line(out, "parsec_serve_jobs_inflight", r, j["inflight"])
+        out.append("# TYPE parsec_serve_jobs_done_total counter")
+        _line(out, "parsec_serve_jobs_done_total", r, j["done"])
+        _line(out, "parsec_serve_jobs_failed_total", r, j["failed"])
+        _line(out, "parsec_serve_jobs_cancelled_total", r,
+              j["cancelled"])
+        _line(out, "parsec_serve_jobs_rejected_total", r, j["rejected"])
+        out.append("# HELP parsec_tenant_retired_total tasks retired "
+                   "per tenant (completed + in-flight jobs)")
+        out.append("# TYPE parsec_tenant_retired_total counter")
+        for name, t in sorted(sv["tenants"].items()):
+            lab = {**r, "tenant": name}
+            _line(out, "parsec_tenant_retired_total", lab, t["retired"])
+            _line(out, "parsec_tenant_weight", lab, t["weight"])
+            _line(out, "parsec_tenant_jobs_inflight", lab, t["inflight"])
+            _line(out, "parsec_tenant_jobs_queued", lab, t["queued"])
+            _line(out, "parsec_tenant_jobs_done_total", lab,
+                  t["completed"])
+            _line(out, "parsec_tenant_jobs_rejected_total", lab,
+                  t["rejected"])
+            _line(out, "parsec_tenant_rate_tasks_per_s", lab,
+                  t["rate_tasks_per_s"])
+            if t["eta_s"] is not None:
+                _line(out, "parsec_tenant_eta_seconds", lab, t["eta_s"])
 
     wd = doc["watchdog"]
     _line(out, "parsec_watchdog_stalled", r,
@@ -738,19 +791,13 @@ class Watchdog:
             self._fail_pools(pools, report)
 
     def _fail_pools(self, pools: List[Any], report: StallReport) -> None:
-        from ..comm.remote_dep import _fail_pool
+        from ..comm.remote_dep import fail_pool_for_context
 
         why = ("watchdog: stalled for >= %gs with no progress; %s"
                % (self.window, report.render()))
-        ctx = self.context
-        rd = getattr(ctx.comm, "remote_dep", None) \
-            if ctx.comm is not None else None
         for tp in pools:
             try:
-                if ctx.nranks > 1 and rd is not None:
-                    rd._fail_pool_everywhere(tp, why)
-                else:
-                    _fail_pool(tp, why)
+                fail_pool_for_context(self.context, tp, why)
             except Exception as e:
                 debug.warning("watchdog could not fail pool %s: %s",
                               getattr(tp, "name", tp), e)
@@ -794,6 +841,26 @@ class Watchdog:
             + " in flight (a body silent longer than the window looks "
               "identical to a wedge — raise runtime_watchdog_window if "
               "that is legitimate here)"))
+
+        # serving plane: name the tenant whose pool is wedged FIRST —
+        # on a multi-tenant mesh "which client is stuck" is the page
+        # the operator acts on before any protocol-level finding
+        for tp in pools:
+            tenant = getattr(tp, "tenant", None)
+            if not tenant:
+                continue
+            prog = tp.progress()
+            pos = f"{prog['retired']}"
+            if prog["known"] is not None:
+                pos += f"/{prog['known']}"
+            findings.append(Finding(
+                "OBS008",
+                f"tenant {tenant!r}: job pool "
+                f"{tp.name}#{tp.taskpool_id} stalled at {pos} tasks "
+                f"retired (job priority "
+                f"{getattr(tp, 'job_priority', 0)}, tenant weight "
+                f"{getattr(tp, 'tenant_weight', 1)})",
+                task=tenant))
 
         for tp in pools:
             prog = tp.progress()

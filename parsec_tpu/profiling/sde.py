@@ -50,6 +50,13 @@ COLL_OPS_STARTED = "PARSEC::COLL::OPS_STARTED"
 COLL_OPS_DONE = "PARSEC::COLL::OPS_DONE"
 COLL_BYTES = "PARSEC::COLL::BYTES"
 COLL_SEGMENTS_INFLIGHT = "PARSEC::COLL::SEGMENTS_INFLIGHT"
+# serving-plane counters (serve.RuntimeService.status_doc — read 0 when
+# no service is attached to the context)
+SERVE_JOBS_QUEUED = "PARSEC::SERVE::JOBS_QUEUED"
+SERVE_JOBS_INFLIGHT = "PARSEC::SERVE::JOBS_INFLIGHT"
+SERVE_JOBS_DONE = "PARSEC::SERVE::JOBS_DONE"
+SERVE_JOBS_REJECTED = "PARSEC::SERVE::JOBS_REJECTED"
+SERVE_TENANTS = "PARSEC::SERVE::TENANTS"
 
 _lock = threading.Lock()
 _counters: Dict[str, float] = {}

@@ -462,6 +462,56 @@ def cmd_flightdump(args) -> int:
     return 0 if paths else 1
 
 
+def cmd_serve_status(args) -> int:
+    """Render the per-tenant serving table of a live ``/status``
+    endpoint (the ``serve`` section ``serve.RuntimeService`` exports)."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    url = args.url.rstrip("/") + "/status"
+    try:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            doc = _json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        print(f"serve-status: {e.code} from {url}", file=sys.stderr)
+        return 1
+    except (OSError, ValueError) as e:
+        print(f"serve-status: cannot read {url}: {e}", file=sys.stderr)
+        return 1
+    sv = doc.get("serve")
+    if not sv:
+        print(f"serve-status: rank {doc.get('rank')} at {args.url} has "
+              "no serving plane attached (not a RuntimeService context)",
+              file=sys.stderr)
+        return 1
+    j = sv["jobs"]
+    print(f"rank {doc.get('rank')} serve: scheduler={sv['scheduler']} "
+          f"fairness={'on' if sv['fairness'] else 'off'}"
+          f"{' CLOSING' if sv['closing'] else ''}")
+    lim = sv["limits"]
+    print(f"  limits: inflight<={lim['max_inflight_pools']} "
+          f"backlog<={lim['max_ready_backlog']} "
+          f"arena<={lim['arena_budget'] or 'inf'} "
+          f"queue<={lim['max_queued']}")
+    print(f"  jobs: {j['inflight']} in flight, {j['queued']} queued, "
+          f"{j['done']} done, {j['failed']} failed, "
+          f"{j['cancelled']} cancelled, {j['rejected']} rejected, "
+          f"{j['expired']} expired")
+    hdr = (f"  {'tenant':<16}{'w':>3}{'run':>5}{'queue':>6}{'done':>6}"
+           f"{'fail':>5}{'rej':>5}{'retired':>9}{'tasks/s':>9}"
+           f"{'eta_s':>7}")
+    print(hdr)
+    for name in sorted(sv["tenants"]):
+        t = sv["tenants"][name]
+        eta = f"{t['eta_s']:.1f}" if t["eta_s"] is not None else "-"
+        print(f"  {name:<16}{t['weight']:>3}{t['inflight']:>5}"
+              f"{t['queued']:>6}{t['completed']:>6}{t['failed']:>5}"
+              f"{t['rejected']:>5}{t['retired']:>9}"
+              f"{t['rate_tasks_per_s']:>9.1f}{eta:>7}")
+    return 0
+
+
 def _cache_store(args):
     """(executable store, tuning store) for the CLI — both rooted in
     --dir when given, so stats/purge never mix an explicit root's
@@ -655,6 +705,14 @@ def main(argv=None) -> int:
                     "SERVER process writes there; default: its cwd or "
                     "PARSEC_TPU_FLIGHT_DIR)")
     pf.set_defaults(fn=cmd_flightdump)
+    ps = sub.add_parser(
+        "serve-status", help="per-tenant serving table of a live "
+        "RuntimeService mesh: jobs in flight/queued/done, retired "
+        "tasks, rates and ETAs per tenant (reads /status of a "
+        "PARSEC_TPU_HEALTH endpoint)")
+    ps.add_argument("url", help="http://host:port of a live health "
+                    "endpoint whose context carries a RuntimeService")
+    ps.set_defaults(fn=cmd_serve_status)
     pe = sub.add_parser(
         "cache", help="persistent executable cache maintenance: list "
         "entries, stats, purge, integrity verify "

@@ -1,0 +1,28 @@
+"""parsec_tpu.serve — the multi-tenant serving plane.
+
+One persistent mesh (:class:`~parsec_tpu.core.context.Context`)
+admitting a stream of taskpools from many tenants, with admission
+control, weighted fairness, and per-tenant observability.  See
+:mod:`parsec_tpu.serve.service` and docs/USERGUIDE.md
+"Serving many workloads".
+"""
+
+from .service import (
+    AdmissionError,
+    JobHandle,
+    RuntimeService,
+    Tenant,
+    compose_priority,
+    JOB_PRIORITY_SPAN,
+    TASK_PRIORITY_SPAN,
+)
+
+__all__ = [
+    "AdmissionError",
+    "JobHandle",
+    "RuntimeService",
+    "Tenant",
+    "compose_priority",
+    "JOB_PRIORITY_SPAN",
+    "TASK_PRIORITY_SPAN",
+]

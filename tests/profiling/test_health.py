@@ -175,6 +175,10 @@ def test_sde_doc_drift_after_dpotrf(clean_sde):
     # ...and so must the runtime-collective gauge set (PR 8)
     assert {sde.COLL_OPS_STARTED, sde.COLL_OPS_DONE, sde.COLL_BYTES,
             sde.COLL_SEGMENTS_INFLIGHT} <= documented
+    # ...and the serving-plane gauge set (PR 9)
+    assert {sde.SERVE_JOBS_QUEUED, sde.SERVE_JOBS_INFLIGHT,
+            sde.SERVE_JOBS_DONE, sde.SERVE_JOBS_REJECTED,
+            sde.SERVE_TENANTS} <= documented
 
     n, nb = 64, 16
     rng = np.random.default_rng(5)

@@ -436,6 +436,94 @@ def scenario_ptg_qr(ce):
 
 
 
+def scenario_multipool(ce):
+    """Concurrent heterogeneous taskpools on ONE context per rank over
+    the REAL wire (the serving-plane correctness floor): a distributed
+    dpotrf, a no-pivot LU and a cross-rank chain execute SIMULTANEOUSLY,
+    their activations interleaving on one TCP engine.  Every local tile
+    must be BIT-IDENTICAL to a solo single-process run of the same
+    factorization, and each pool's termdet must close its books."""
+    from parsec_tpu.datadist import TiledMatrix, TwoDimBlockCyclic
+    from parsec_tpu.ops.cholesky import cholesky_ptg
+    from parsec_tpu.ops.lu import lu_ptg
+
+    N, nb = 64, 16
+    rng = np.random.default_rng(42)
+    M = rng.standard_normal((N, N))
+    SPD = M @ M.T + N * np.eye(N)
+    LUIN = rng.standard_normal((N, N)) + N * np.eye(N)
+
+    # solo references, computed in THIS process on plain single-rank
+    # contexts (bit-identical is the contract: per-tile ops see the
+    # same operand bits in the same per-task order either way)
+    refs = {}
+    for key, data, build in (("chol", SPD, cholesky_ptg),
+                             ("lu", LUIN, lu_ptg)):
+        sctx = Context(nb_cores=2)
+        try:
+            A = TiledMatrix(N, N, nb, nb, name=f"solo_{key}")
+            A.from_array(data)
+            stp = build(use_tpu=False).taskpool(NT=A.mt, A=A)
+            sctx.add_taskpool(stp)
+            assert stp.wait(timeout=120), f"solo {key} hung"
+            refs[key] = A.to_array()
+        finally:
+            sctx.fini()
+
+    ctx = Context(nb_cores=2, rank=ce.rank, nranks=ce.nranks, comm=ce)
+    try:
+        A = TwoDimBlockCyclic(N, N, nb, nb, p=ce.nranks, q=1,
+                              myrank=ce.rank, name="mpA")
+        A.from_array(SPD)
+        B = TwoDimBlockCyclic(N, N, nb, nb, p=1, q=ce.nranks,
+                              myrank=ce.rank, name="mpB")
+        B.from_array(LUIN)
+        dc = LocalCollection("mpD", shape=(1,), nodes=ce.nranks,
+                             myrank=ce.rank, init=lambda k: np.zeros(2))
+        dc.rank_of = lambda *key: dc.data_key(*key) % ce.nranks
+        nchain = 10
+        ptg = PTG("mpchain")
+        step = ptg.task_class("step", k="0 .. N-1")
+        step.affinity("D(k)")
+        step.flow("X", INOUT,
+                  "<- (k == 0) ? D(0) : X step(k-1)",
+                  "-> (k < N-1) ? X step(k+1) : D(k)")
+        step.body(cpu=lambda X, k: X.__iadd__(1.0))
+
+        pools = [
+            ("chol", cholesky_ptg(use_tpu=False).taskpool(NT=A.mt, A=A)),
+            ("lu", lu_ptg(use_tpu=False).taskpool(NT=B.mt, A=B)),
+            ("chain", ptg.taskpool(N=nchain, D=dc)),
+        ]
+        ce.barrier()
+        for _, tp in pools:
+            ctx.add_taskpool(tp)
+        bad = 0
+        for key, tp in pools:
+            assert tp.wait(timeout=240), f"{key} hung concurrently"
+            # clean termdet per pool
+            nbt = getattr(tp.tdm, "_nb_tasks", None)
+            assert not isinstance(nbt, int) or nbt <= 0, (key, nbt)
+            assert not tp.failed
+        ce.barrier()  # all ranks quiesced before reading tiles
+        for key, coll, ref in (("chol", A, refs["chol"]),
+                               ("lu", B, refs["lu"])):
+            for (i, j) in coll.local_tiles():
+                got = np.asarray(
+                    coll.data_of(i, j).newest_copy().payload)
+                want = ref[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb]
+                if not np.array_equal(got, want):
+                    bad += 1
+        assert bad == 0, f"rank {ce.rank}: {bad} tiles differ from solo"
+        if dc.rank_of(nchain - 1) == ce.rank:
+            final = dc.data_of(nchain - 1).newest_copy().payload
+            np.testing.assert_array_equal(final, np.full(2, float(nchain)))
+        return {"tiles_checked": len(list(A.local_tiles()))
+                + len(list(B.local_tiles()))}
+    finally:
+        ctx.fini()
+
+
 def scenario_barrier_close(ce):
     """Regression: barrier releases queued just before close() must be
     flushed. Late ranks enter the barrier while rank 0 is already past

@@ -164,6 +164,15 @@ def test_tcp_dtt_pingpong_mixed_layouts(nb, kinds):
     assert all(o["pld_kinds"] == kinds for o in out), out
 
 
+def test_tcp_multipool_2ranks():
+    """Serving-plane floor over the REAL wire: dpotrf + LU + a
+    cross-rank chain run CONCURRENTLY on one context per rank; every
+    local tile must be bit-identical to a solo single-process run and
+    each pool's termdet must close (tcp_driver scenario_multipool)."""
+    out = run_scenario("multipool", 2, timeout=420)
+    assert all(o["tiles_checked"] > 0 for o in out)
+
+
 def test_tcp_collectives_4ranks():
     """Runtime collectives over real sockets: allreduce (chunked ring),
     reduce-scatter, allgather, bcast — the TCP side of the inproc parity

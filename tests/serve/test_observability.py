@@ -1,0 +1,242 @@
+"""Per-tenant observability slices: tenant labels on /metrics and
+/status, the serve SDE gauge set, the OBS008 stalled-tenant watchdog
+finding, per-tenant critical-path attribution, and the
+``tools serve-status`` CLI."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl.ptg import PTG, INOUT
+from parsec_tpu.profiling import sde
+from parsec_tpu.profiling.health import HealthServer, Watchdog
+from parsec_tpu.serve import RuntimeService
+
+
+@pytest.fixture
+def clean_sde():
+    sde.reset()
+    yield
+    sde.reset()
+
+
+def _gated_job(name, gate, n=5, entered=None):
+    dc = LocalCollection("D", shape=(1,), init=lambda k: np.zeros(1))
+    ptg = PTG(name)
+    step = ptg.task_class("step", k="0 .. N-1")
+    step.affinity("D(0)")
+    step.flow("X", INOUT, "<- (k == 0) ? D(0) : X step(k-1)",
+              "-> (k < N-1) ? X step(k+1) : D(0)")
+
+    def body(X, k):
+        if k == 0:
+            if entered is not None:
+                entered.set()
+            assert gate.wait(timeout=60)
+        X += 1.0
+
+    step.body(cpu=body)
+    return ptg.taskpool(N=n, D=dc), dc
+
+
+def _get(url: str):
+    return urllib.request.urlopen(url, timeout=10).read().decode()
+
+
+def test_metrics_and_status_carry_tenant_slices(clean_sde):
+    with RuntimeService(nb_cores=2) as sv:
+        hs = HealthServer(sv.context).start()
+        gate = threading.Event()
+        entered = threading.Event()
+        tp, _ = _gated_job("tenjob", gate, entered=entered)
+        sv.tenant("acme", weight=3)
+        h = sv.submit("acme", tp, priority=1)
+        try:
+            assert entered.wait(timeout=30)
+            text = _get(hs.url + "/metrics")
+            # taskpool gauges grew the tenant label
+            assert 'name="tenjob"' in text
+            assert 'tenant="acme"' in text
+            # per-tenant family
+            assert 'parsec_tenant_retired_total{rank="0",tenant="acme"}' \
+                in text
+            assert 'parsec_tenant_weight{rank="0",tenant="acme"} 3' \
+                in text
+            assert 'parsec_tenant_jobs_inflight{rank="0",tenant="acme"}'\
+                ' 1' in text
+            assert "parsec_serve_jobs_inflight" in text
+            # /status: the serve document
+            st = json.loads(_get(hs.url + "/status"))
+            assert st["serve"] is not None
+            ten = st["serve"]["tenants"]["acme"]
+            assert ten["weight"] == 3 and ten["inflight"] == 1
+            assert st["taskpools"][0]["tenant"] == "acme"
+            # the serve SDE gauges read through the service
+            assert sde.read(sde.SERVE_JOBS_INFLIGHT) == 1.0
+            assert sde.read(sde.SERVE_TENANTS) == 1.0
+        finally:
+            gate.set()
+        assert h.wait(timeout=60)
+        assert sde.read(sde.SERVE_JOBS_DONE) == 1.0
+        hs.stop()
+
+
+def test_serve_status_cli_renders_tenant_table(clean_sde, capsys):
+    from parsec_tpu.profiling import tools
+
+    with RuntimeService(nb_cores=2) as sv:
+        hs = HealthServer(sv.context).start()
+        gate = threading.Event()
+        gate.set()
+        for i in range(2):
+            assert sv.submit("acme", _gated_job(f"j{i}", gate)[0]) \
+                .wait(timeout=60)
+        rc = tools.main(["serve-status", hs.url])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "acme" in out and "done" in out
+        assert "scheduler=wdrr fairness=on" in out
+        hs.stop()
+    # a plain context (no service) is a readable error, not a crash
+    from parsec_tpu import Context
+
+    ctx = Context(nb_cores=1)
+    hs = HealthServer(ctx).start()
+    try:
+        rc = tools.main(["serve-status", hs.url])
+        assert rc == 1
+        assert "no serving plane" in capsys.readouterr().err
+    finally:
+        hs.stop()
+        ctx.fini()
+
+
+def test_watchdog_obs008_names_stalled_tenant(clean_sde):
+    """A wedged tenant job must surface as OBS008 naming the tenant —
+    the 'which client is stuck' line the operator pages on."""
+    with RuntimeService(nb_cores=2) as sv:
+        wd = Watchdog(sv.context, window=0.6, poll=0.1).start()
+        sv.context.watchdog = wd
+        gate = threading.Event()
+        entered = threading.Event()
+        tp, _ = _gated_job("stuckjob", gate, entered=entered)
+        h = sv.submit("victim-tenant", tp)
+        try:
+            assert entered.wait(timeout=30)
+            deadline = threading.Event()
+            for _ in range(100):
+                if wd.stalled:
+                    break
+                deadline.wait(0.1)
+            assert wd.stalled, "watchdog never fired on the wedged job"
+            rep = wd.last_report.render()
+            codes = [f.code for f in wd.last_report.findings]
+            assert "OBS008" in codes
+            assert "victim-tenant" in rep
+            assert "stuckjob" in rep
+        finally:
+            gate.set()
+        assert h.wait(timeout=60)
+        wd.stop()
+
+
+def test_critpath_attributes_per_tenant():
+    """Synthetic trace: tenant: instants map chain tasks to tenants and
+    the report splits buckets per tenant (tools critpath table)."""
+    from parsec_tpu.profiling import critpath
+
+    def span(tok, b, e):
+        return [
+            {"name": "exec", "ph": "B", "ts": b, "pid": 0, "tid": "w",
+             "args": {"event_id": tok}},
+            {"name": "exec", "ph": "E", "ts": e, "pid": 0, "tid": "w",
+             "args": {"event_id": tok}},
+        ]
+
+    evs = []
+    evs += span(1, 0, 100)
+    evs += span(2, 150, 250)
+    evs += span(3, 300, 400)
+    evs += [{"name": "dep_edge", "ph": "i", "ts": 0.0, "pid": 0,
+             "tid": "w", "args": {"event_id": 1, "info": 2}},
+            {"name": "dep_edge", "ph": "i", "ts": 0.0, "pid": 0,
+             "tid": "w", "args": {"event_id": 2, "info": 3}}]
+    for tok, cls in ((1, "a"), (2, "b"), (3, "a")):
+        evs.append({"name": f"class:{cls}", "ph": "i", "ts": 0.0,
+                    "pid": 0, "tid": "w", "args": {"event_id": tok}})
+    for tok, ten in ((1, "acme"), (2, "globex"), (3, "acme")):
+        evs.append({"name": f"tenant:{ten}", "ph": "i", "ts": 0.0,
+                    "pid": 0, "tid": "w", "args": {"event_id": tok}})
+    rep = critpath.analyze(evs)
+    assert rep["n_tasks"] == 3
+    pt = rep["per_tenant"]
+    assert pt["acme"]["count"] == 2
+    assert pt["acme"]["compute_us"] == pytest.approx(200.0)
+    assert pt["globex"]["count"] == 1
+    assert pt["globex"]["compute_us"] == pytest.approx(100.0)
+    # the rendered report carries the tenant table
+    text = critpath.render(rep)
+    assert "acme" in text and "globex" in text
+
+
+def test_live_trace_tags_tenant_tokens():
+    """A RankTraceSet over a service run records tenant:<name> instants
+    for served pools (skipped when the native trace engine is absent)."""
+    from parsec_tpu import native
+
+    if not native.available():
+        pytest.skip("native trace engine unavailable")
+    import os
+    import tempfile
+
+    from parsec_tpu.profiling import critpath
+    from parsec_tpu.profiling.binary import RankTraceSet, to_chrome_events
+
+    traces = RankTraceSet(1).install()
+    try:
+        with RuntimeService(nb_cores=2) as sv:
+            gate = threading.Event()
+            gate.set()
+            assert sv.submit("traced-tenant",
+                             _gated_job("tj", gate)[0]).wait(timeout=60)
+    finally:
+        with tempfile.TemporaryDirectory() as d:
+            paths = traces.dump(d)
+            traces.uninstall()
+            evs = []
+            for p in paths:
+                evs.extend(to_chrome_events(p))
+    assert any(str(e.get("name", "")).startswith("tenant:traced-tenant")
+               for e in evs)
+    rep = critpath.analyze(evs)
+    assert "traced-tenant" in rep["per_tenant"]
+
+
+def test_operations_doc_names_serve_rows():
+    """Doc-drift (serving plane): OPERATIONS.md must document the
+    serve_* MCA params, the per-tenant metric family, and OBS008."""
+    import os
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    ops_md = os.path.join(here, "..", "..", "docs", "OPERATIONS.md")
+    with open(ops_md) as f:
+        text = f.read()
+    for param in ("serve_max_inflight_pools", "serve_max_ready_backlog",
+                  "serve_arena_budget", "serve_max_queued"):
+        assert param in text, f"OPERATIONS.md misses MCA row {param}"
+    for metric in ("parsec_tenant_retired_total",
+                   "parsec_serve_jobs_queued", "parsec_tenant_weight"):
+        assert metric in text, f"OPERATIONS.md misses metric {metric}"
+    assert "OBS008" in text, "OPERATIONS.md misses the OBS008 row"
+    documented = set(re.findall(r"`(PARSEC::[A-Z_:]+)`", text))
+    assert {sde.SERVE_JOBS_QUEUED, sde.SERVE_JOBS_INFLIGHT,
+            sde.SERVE_JOBS_DONE, sde.SERVE_JOBS_REJECTED,
+            sde.SERVE_TENANTS} <= documented, \
+        "OPERATIONS.md misses serve SDE rows"
+    assert "serve-status" in text, \
+        "OPERATIONS.md misses the serve-status tool"

@@ -1,0 +1,495 @@
+"""RuntimeService core behavior: submit/wait/status, admission
+queueing + quotas, cancel (queued and running, without poisoning
+co-resident pools), drain, deadline expiry, priority composition, and
+the per-pool progress()/wait_taskpool semantics regression."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context, Task, TaskClass, Taskpool
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl.ptg import PTG, INOUT
+from parsec_tpu.serve import (
+    AdmissionError,
+    RuntimeService,
+    compose_priority,
+    JOB_PRIORITY_SPAN,
+    TASK_PRIORITY_SPAN,
+)
+
+
+def chain_tp(n, name="chain", gate=None, body_extra=None):
+    """An n-task dependency chain incrementing one tile; optionally the
+    FIRST task blocks on ``gate`` (pool wedged open until the test says
+    go)."""
+    dc = LocalCollection("D", shape=(1,), init=lambda k: np.zeros(1))
+    ptg = PTG(name)
+    step = ptg.task_class("step", k="0 .. N-1")
+    step.affinity("D(0)")
+    step.flow("X", INOUT, "<- (k == 0) ? D(0) : X step(k-1)",
+              "-> (k < N-1) ? X step(k+1) : D(0)")
+
+    def body(X, k):
+        if body_extra is not None:
+            body_extra(k)
+        if k == 0 and gate is not None:
+            assert gate.wait(timeout=60)
+        X += 1.0
+
+    step.body(cpu=body)
+    return ptg.taskpool(N=n, D=dc), dc
+
+
+def final_value(dc):
+    return float(dc.data_of(0).newest_copy().payload[0])
+
+
+def test_submit_wait_and_tenant_accounting():
+    with RuntimeService(nb_cores=2) as sv:
+        handles = []
+        for i in range(6):
+            tp, dc = chain_tp(5, name=f"job{i}")
+            h = sv.submit("alice" if i % 2 else "bob", tp, priority=i)
+            handles.append((h, dc))
+        for h, dc in handles:
+            assert h.wait(timeout=60), h.status()
+            assert h.state == "done"
+            assert h.latency_s is not None and h.latency_s >= 0
+            assert final_value(dc) == 5.0
+        doc = sv.status_doc()
+        assert doc["jobs"]["done"] == 6
+        assert doc["tenants"]["alice"]["completed"] == 3
+        assert doc["tenants"]["bob"]["completed"] == 3
+        assert doc["tenants"]["alice"]["retired"] == 15
+        # the service context runs the fairness scheduler by default
+        assert doc["scheduler"] == "wdrr"
+
+
+def test_backpressure_queues_then_admits_in_order():
+    with RuntimeService(nb_cores=2) as sv:
+        sv.max_inflight_pools = 1
+        gate = threading.Event()
+        tp0, _ = chain_tp(3, "gated", gate=gate)
+        h0 = sv.submit("t", tp0)
+        followers = [sv.submit("t", chain_tp(2, f"f{i}")[0])
+                     for i in range(3)]
+        time.sleep(0.2)
+        assert h0.state == "running"
+        assert all(h.state == "queued" for h in followers)
+        assert sv.status_doc()["jobs"]["queued"] == 3
+        gate.set()
+        for h in followers:
+            assert h.wait(timeout=60), h.status()
+        assert h0.wait(timeout=60)
+
+
+def test_quota_rejection_and_service_queue_bound():
+    with RuntimeService(nb_cores=2) as sv:
+        sv.max_inflight_pools = 1
+        gate = threading.Event()
+        h0 = sv.submit("noisy", chain_tp(2, gate=gate)[0])
+        sv.tenant("noisy", max_queued=1)
+        h1 = sv.submit("noisy", chain_tp(2)[0])  # fills the queue quota
+        with pytest.raises(AdmissionError, match="max_queued"):
+            sv.submit("noisy", chain_tp(2)[0])
+        # another tenant is NOT affected by noisy's quota
+        h2 = sv.submit("polite", chain_tp(2)[0])
+        # ...but the service-wide bound rejects everyone
+        sv.max_queued = 2
+        with pytest.raises(AdmissionError, match="queue full"):
+            sv.submit("polite", chain_tp(2)[0])
+        assert sv.status_doc()["tenants"]["noisy"]["rejected"] == 1
+        gate.set()
+        for h in (h0, h1, h2):
+            assert h.wait(timeout=60)
+
+
+def test_cancel_queued_and_running_without_poisoning_neighbors():
+    with RuntimeService(nb_cores=2) as sv:
+        sv.max_inflight_pools = 2
+        gate = threading.Event()
+        victim_tp, _ = chain_tp(4, "victim", gate=gate)
+        buddy_gate = threading.Event()
+        buddy_tp, buddy_dc = chain_tp(4, "buddy", gate=buddy_gate)
+        victim = sv.submit("a", victim_tp)
+        buddy = sv.submit("b", buddy_tp)
+        queued = sv.submit("a", chain_tp(2)[0])
+        time.sleep(0.1)
+        assert queued.state == "queued"
+        assert queued.cancel()
+        assert queued.state == "cancelled"
+        # abort the RUNNING victim: its wait() fails promptly, the
+        # co-resident buddy keeps running and completes untouched
+        assert victim.cancel()
+        assert not victim.wait(timeout=30)
+        assert victim.state == "cancelled"
+        assert "cancelled by service" in victim.fail_reason
+        buddy_gate.set()
+        gate.set()  # let the victim's wedged first task unblock too
+        assert buddy.wait(timeout=60), buddy.status()
+        assert final_value(buddy_dc) == 4.0
+        doc = sv.status_doc()
+        assert doc["jobs"]["cancelled"] == 2
+        assert doc["jobs"]["done"] == 1
+
+
+def test_drain_tenant_leaves_other_tenants_alone():
+    with RuntimeService(nb_cores=2) as sv:
+        sv.max_inflight_pools = 2
+        gate = threading.Event()
+        a_run = sv.submit("a", chain_tp(3, "a0", gate=gate)[0])
+        b_run = sv.submit("b", chain_tp(3, "b0", gate=gate)[0])
+        a_q = sv.submit("a", chain_tp(2, "a1")[0])
+        b_q = sv.submit("b", chain_tp(2, "b1")[0])
+        gate.set()
+
+        assert sv.drain("a", timeout=60)
+        assert a_run.state in ("done", "cancelled")
+        assert a_q.state in ("cancelled", "done")
+        # b's queue survived the drain of a
+        assert b_q.state in ("queued", "running", "done")
+        assert b_run.wait(timeout=60)
+        assert b_q.wait(timeout=60)
+
+
+def test_deadline_expires_queued_job():
+    with RuntimeService(nb_cores=2) as sv:
+        sv.max_inflight_pools = 1
+        gate = threading.Event()
+        h0 = sv.submit("t", chain_tp(2, gate=gate)[0])
+        h1 = sv.submit("t", chain_tp(2)[0], deadline=0.15)
+        assert not h1.wait(timeout=30)
+        assert h1.state == "failed"
+        assert "deadline expired" in h1.fail_reason
+        gate.set()
+        assert h0.wait(timeout=60)
+        assert sv.status_doc()["jobs"]["expired"] == 1
+
+
+def test_graceful_close_runs_queued_jobs_to_completion():
+    """Review regression: close(cancel_queued=False) must let parked
+    QUEUED jobs admit and finish — closing blocks submission, not
+    admission — instead of stranding them (and their waiters) forever."""
+    sv = RuntimeService(nb_cores=2)
+    sv.max_inflight_pools = 1
+    gate = threading.Event()
+    h0 = sv.submit("t", chain_tp(2, gate=gate)[0])
+    tp1, dc1 = chain_tp(3)
+    h1 = sv.submit("t", tp1)
+    time.sleep(0.1)
+    assert h1.state == "queued"
+    gate.set()
+    assert sv.close(timeout=60, cancel_queued=False)
+    assert h0.state == "done" and h1.state == "done"
+    assert final_value(dc1) == 3.0
+    # the admitter thread really exited (close joins it)
+    assert not sv._admitter.is_alive()
+
+
+def test_failure_mentioning_cancelled_is_not_booked_as_cancellation():
+    """Review regression: CANCELLED vs FAILED keys off the service's
+    own cancel flag, not fail-reason text — a body failure whose
+    message contains 'cancelled by' must still count as FAILED."""
+    with RuntimeService(nb_cores=2) as sv:
+        dc = LocalCollection("F", shape=(1,), init=lambda k: np.zeros(1))
+        ptg = PTG("poison")
+        step = ptg.task_class("step", k="0 .. 2")
+        step.affinity("F(0)")
+        step.flow("X", INOUT, "<- (k == 0) ? F(0) : X step(k-1)",
+                  "-> (k < 2) ? X step(k+1) : F(0)")
+
+        def body(X, k):
+            X += 1.0
+            if k == 1:
+                raise RuntimeError("request cancelled by upstream peer")
+
+        step.body(cpu=body)
+        h = sv.submit("t", ptg.taskpool(F=dc))
+        assert not h.wait(timeout=60)
+        assert h.state == "failed", h.status()
+        doc = sv.status_doc()
+        assert doc["jobs"]["failed"] == 1
+        assert doc["jobs"]["cancelled"] == 0
+        # the partially-run job's retirements stay in the tenant total
+        # (the exported counter must be monotonic across failures)
+        assert doc["tenants"]["t"]["retired"] >= 1
+
+
+def test_submit_after_close_rejected():
+    sv = RuntimeService(nb_cores=2)
+    assert sv.close(timeout=30)
+    with pytest.raises(AdmissionError, match="closing"):
+        sv.submit("t", chain_tp(2)[0])
+    assert sv.close(timeout=5)  # idempotent
+
+
+def test_attach_failure_fails_pool_and_wakes_waiters():
+    """Review regression: when Context.add_taskpool raises during
+    admission, the pool itself must TERMINATE (failed) — a client
+    already blocked in wait() would otherwise hang forever on an event
+    nobody can set."""
+    with RuntimeService(nb_cores=2) as sv:
+        boom = RuntimeError("termdet slot taken")
+        orig = sv.context.add_taskpool
+
+        def exploding(tp):
+            raise boom
+
+        sv.context.add_taskpool = exploding
+        try:
+            tp, _ = chain_tp(3, "doomed")
+            waited = []
+            h = sv.submit("t", tp)
+
+            def waiter():
+                waited.append(h.wait(timeout=30))
+
+            th = threading.Thread(target=waiter)
+            th.start()
+            th.join(timeout=10)
+            assert not th.is_alive(), \
+                "waiter hung on a never-attached pool"
+            assert waited == [False]
+            assert h.state == "failed"
+            assert "add_taskpool raised" in h.fail_reason
+            assert tp.failed and tp.is_done()
+        finally:
+            sv.context.add_taskpool = orig
+
+
+def test_submit_with_tenant_object_registers_it():
+    """Review regression: a caller-constructed Tenant must become THE
+    registry entry (visible in status_doc, single quota budget); a
+    conflicting second object for the same name is rejected."""
+    from parsec_tpu.serve import Tenant
+
+    with RuntimeService(nb_cores=2) as sv:
+        t = Tenant("gold", weight=4, max_queued=2)
+        h = sv.submit(t, chain_tp(3)[0])
+        assert h.wait(timeout=60)
+        assert sv.tenants["gold"] is t
+        assert sv.status_doc()["tenants"]["gold"]["completed"] == 1
+        # by-name submission reuses the SAME object (one budget)
+        h2 = sv.submit("gold", chain_tp(3)[0])
+        assert h2.wait(timeout=60) and h2.tenant is t
+        with pytest.raises(AdmissionError, match="different object"):
+            sv.submit(Tenant("gold", weight=1), chain_tp(3)[0])
+
+
+def test_backlog_of_instantly_empty_pools_does_not_recurse():
+    """Review regression: a pool that terminates synchronously INSIDE
+    add_taskpool re-enters the admission pump via on_complete; with a
+    long backlog of such pools the old recursive pump grew the stack
+    by the queue length (RecursionError killed the admitter).  The
+    iterative pump must drain hundreds without deepening the stack."""
+    njobs = 300
+    with RuntimeService(nb_cores=2) as sv:
+        sv.max_inflight_pools = 1
+        gate = threading.Event()
+        holder = sv.submit("t", chain_tp(2, gate=gate)[0])
+        empties = [sv.submit("t", Taskpool(f"e{i}", nb_tasks=0))
+                   for i in range(njobs)]
+        time.sleep(0.1)
+        assert all(h.state == "queued" for h in empties)
+        gate.set()
+        assert holder.wait(timeout=60)
+        for h in empties:
+            assert h.wait(timeout=60), h.status()
+        assert sv._admitter.is_alive()
+        assert sv.status_doc()["jobs"]["done"] == njobs + 1
+
+
+def test_submit_fast_path_covers_only_its_own_job():
+    """Review regression: submit() may fast-path ITS OWN job, but must
+    never run another queued job's attach (startup enumeration) on the
+    caller's thread — older queue entries belong to the admitter."""
+    with RuntimeService(nb_cores=2) as sv:
+        sv.max_inflight_pools = 0  # park everything
+        old = [sv.submit("a", chain_tp(2, f"old{i}")[0])
+               for i in range(2)]
+        time.sleep(0.05)
+        assert all(h.state == "queued" for h in old)
+        attached_by = []
+        orig = sv.context.add_taskpool
+
+        def spy(tp):
+            attached_by.append((threading.current_thread().name,
+                                tp.name))
+            return orig(tp)
+
+        sv.context.add_taskpool = spy
+        try:
+            sv.max_inflight_pools = 4  # capacity for everyone now
+            mine = sv.submit("b", chain_tp(2, "mine")[0])
+            # the submit fast path admitted OUR job synchronously...
+            assert mine.state == "running"
+            me = threading.current_thread().name
+            my_attaches = [nm for thr, nm in attached_by if thr == me]
+            # ...and did not drag the older queue entries onto this
+            # thread (the admitter picks them up on its next tick)
+            assert my_attaches == ["mine"], attached_by
+            assert mine.wait(timeout=60)
+            for h in old:
+                assert h.wait(timeout=60), h.status()
+        finally:
+            sv.context.add_taskpool = orig
+
+
+def test_close_timeout_leaves_live_service_then_succeeds():
+    """Review regression: close(timeout) expiring with jobs live must
+    NOT finalize the mesh under them (waiters would hang forever) —
+    it returns False with a working, submission-closed service; a
+    later close finishes the shutdown (and really finis the context)."""
+    sv = RuntimeService(nb_cores=2)
+    gate = threading.Event()
+    h = sv.submit("t", chain_tp(3, gate=gate)[0])
+    time.sleep(0.1)
+    assert sv.close(timeout=0.3) is False
+    # the mesh is alive: the job can still finish
+    assert h.state == "running"
+    gate.set()
+    assert h.wait(timeout=60)
+    assert sv.close(timeout=30) is True
+    assert not sv._admitter.is_alive()
+
+
+def test_cancel_racing_attach_does_not_leak_active_taskpools():
+    """Review regression: a cancel landing between _admit (RUNNING)
+    and the out-of-lock add_taskpool must not register a terminated
+    pool — that would leak an _active_taskpools slot forever (wait()
+    never quiesces, watchdog pages a dead tenant)."""
+    with RuntimeService(nb_cores=2) as sv:
+        orig = sv.context.add_taskpool
+        in_attach = threading.Event()
+        release = threading.Event()
+
+        def slow_attach(tp):
+            in_attach.set()
+            assert release.wait(timeout=10)
+            return orig(tp)
+
+        sv.context.add_taskpool = slow_attach
+        try:
+            tp, _ = chain_tp(3, "raced")
+            hs = []
+            t = threading.Thread(
+                target=lambda: hs.append(sv.submit("t", tp)))
+            t.start()
+            assert in_attach.wait(timeout=10)
+            # the handle is RUNNING (in _inflight) but the pool is NOT
+            # yet attached — submit itself is still blocked in attach
+            deadline = time.monotonic() + 10
+            while not sv._inflight:
+                assert time.monotonic() < deadline
+                time.sleep(0.001)
+            h = next(iter(sv._inflight.values()))
+            assert h.cancel()
+            release.set()
+            t.join(timeout=10)
+            assert not h.wait(timeout=10)
+            assert h.state == "cancelled"
+        finally:
+            sv.context.add_taskpool = orig
+        with sv.context._cv:
+            assert sv.context._active_taskpools == 0
+        assert sv.context.test()  # the context can still quiesce
+
+
+def test_wrapped_context_reports_fairness_honestly():
+    """Review regression: fairness=True over a caller-provided context
+    that does NOT run wdrr must not claim fairness in telemetry."""
+    ctx = Context(nb_cores=2)  # default scheduler (lfq), not wdrr
+    try:
+        sv = RuntimeService(ctx)
+        assert sv.fairness is False
+        assert sv.status_doc()["fairness"] is False
+        h = sv.submit("t", chain_tp(3)[0])
+        assert h.wait(timeout=60)
+        assert sv.close(timeout=30)
+    finally:
+        ctx.fini()  # close() must NOT have finalized a wrapped context
+
+
+def test_compose_priority_lexicographic_and_task_offset():
+    # lexicographic within the documented bands
+    assert compose_priority(2, 0, 0) > compose_priority(
+        1, JOB_PRIORITY_SPAN - 1, TASK_PRIORITY_SPAN - 1)
+    assert compose_priority(1, 3, 0) > compose_priority(
+        1, 2, TASK_PRIORITY_SPAN - 1)
+    assert compose_priority(1, 2, 7) > compose_priority(1, 2, 6)
+    # negative job priorities sort below positive ones, same tenant
+    assert compose_priority(1, -1, 0) < compose_priority(1, 0, 0)
+
+    # the composed base reaches every Task built under the pool — the
+    # choke point the scheduler pop order AND the priority-ordered
+    # sends read
+    tp = Taskpool("prio", nb_tasks=1)
+    tp.priority_base = compose_priority(3, 5)
+    tc = TaskClass("t")
+    task = Task(tp, tc, (), priority=17)
+    assert task.priority == compose_priority(3, 5, 17)
+
+
+def test_admission_sets_tenant_fields_on_pool():
+    with RuntimeService(nb_cores=2) as sv:
+        sv.tenant("gold", weight=4)
+        tp, _ = chain_tp(3)
+        h = sv.submit("gold", tp, priority=2)
+        assert h.wait(timeout=60)
+        assert tp.tenant == "gold"
+        assert tp.tenant_weight == 4
+        assert tp.job_priority == 2
+        assert tp.priority_base == compose_priority(4, 2)
+        assert tp.progress()["tenant"] == "gold"
+
+
+# ---------------------------------------------------------------------------
+# satellite: per-pool progress()/wait_taskpool semantics with co-resident
+# pools still executing
+# ---------------------------------------------------------------------------
+
+def test_progress_rate_is_per_pool_and_freezes_at_termination():
+    """A finished pool's rate/elapsed must freeze at ITS termination —
+    not decay toward zero while a neighbor keeps the context busy — and
+    wait_taskpool(A) must return while B is still executing."""
+    ctx = Context(nb_cores=2)
+    try:
+        fast_tp, _ = chain_tp(5, "fast")
+        gate = threading.Event()
+        entered = threading.Event()
+        slow_tp, _ = chain_tp(3, "slow", gate=gate,
+                              body_extra=lambda k: entered.set())
+        ctx.add_taskpool(slow_tp)  # wedged open on the gate
+        ctx.start()
+        # the dedicated worker must be INSIDE slow's gated first body
+        # before fast attaches, or the master could pick it up in
+        # wait_taskpool and wedge itself
+        assert entered.wait(timeout=30)
+        time.sleep(0.1)  # slow pool sits live while fast runs
+        ctx.add_taskpool(fast_tp)
+        # wait_taskpool returns on FAST's completion even though SLOW
+        # is still non-terminated on the same context
+        assert ctx.wait_taskpool(fast_tp, timeout=30)
+        assert not slow_tp.is_done()
+        p1 = fast_tp.progress()
+        assert p1["done"] and p1["retired"] == 5
+        assert p1["rate_tasks_per_s"] > 0
+        # the rate window is the pool's OWN attach->terminate span: it
+        # must not shrink as wall time passes with slow still running
+        time.sleep(0.3)
+        p2 = fast_tp.progress()
+        assert p2["elapsed_s"] == p1["elapsed_s"]
+        assert p2["rate_tasks_per_s"] == p1["rate_tasks_per_s"]
+        # slow's own window keeps growing while it is live, and its
+        # rate reflects only its own retirements (first task wedged:
+        # nothing retired yet -> rate 0, not fast's throughput)
+        ps = slow_tp.progress()
+        assert ps["retired"] == 0 and ps["rate_tasks_per_s"] == 0.0
+        gate.set()
+        assert slow_tp.wait(timeout=30)
+        assert slow_tp.progress()["rate_tasks_per_s"] > 0
+    finally:
+        ctx.fini()
