@@ -98,6 +98,40 @@ def _ops_segmented_qr():
          "R": _local("R")}
 
 
+def _attn_planes(G: int, N: int, D: int = 4):
+    from ..ops.attention import NEG_BIG, PlaneCollection
+
+    keys = [(g, j) for g in range(G) for j in range(N)]
+    inits = {
+        "CM": lambda g, j: np.full((4, 1), NEG_BIG, np.float32),
+        "CL": lambda g, j: np.zeros((4, 1), np.float32),
+    }
+    return {
+        name: PlaneCollection(
+            name, inits.get(name, lambda g, j: np.zeros((4, D), np.float32)),
+            keys=keys)
+        for name in ("Q", "K", "V", "O", "CA", "CM", "CL")
+    }
+
+
+def _ops_attention_flash():
+    from ..ops.attention import flash_attention_ptg
+
+    return flash_attention_ptg(causal=True, q_block=4, kv_block=4), \
+        {"G": 2, "NQ": 3, "NK": 3, "QB": 4, "KVB": 4, "QOFF": 0,
+         "SQ": 12, **_attn_planes(2, 3)}
+
+
+def _ops_attention_ring(variant: str):
+    def build():
+        from ..ops.attention import ring_attention_ptg
+
+        return ring_attention_ptg(causal=(variant == "ring"), q_block=4,
+                                  kv_block=4, variant=variant), \
+            {"G": 2, "R": 3, **_attn_planes(2, 3)}
+    return build
+
+
 def _ops_segmented_chol_dist():
     from ..ops.segmented_chol_dist import dist_segmented_cholesky_ptg
 
@@ -127,6 +161,9 @@ GRAPHS: Dict[str, Callable[[], Tuple]] = {
     "ops.segmented_lu": _ops_segmented_lu,
     "ops.segmented_qr": _ops_segmented_qr,
     "ops.segmented_chol_dist": _ops_segmented_chol_dist,
+    "ops.attention_flash": _ops_attention_flash,
+    "ops.attention_ring": _ops_attention_ring("ring"),
+    "ops.attention_ring_bcast": _ops_attention_ring("bcast"),
 }
 
 if os.path.isdir(_JDF_DIR):  # source checkout: lint the example JDFs too

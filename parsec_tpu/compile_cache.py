@@ -652,6 +652,12 @@ class ExecutableCache:
         self.bcast_enabled = bool(bcast) and ce is not None and nranks > 1
         self.ce = ce if self.bcast_enabled else None
         self._pulls: Dict[str, "_BlobPull"] = {}
+        #: program keys already named in the one-time LOCAL_ONLY log —
+        #: an unexportable program (Pallas custom calls, host callbacks)
+        #: recompiles per shape, and each occurrence counts in
+        #: stats["local_only"], but the operator-facing log names each
+        #: program once, not once per shape
+        self._local_only_warned: set = set()
         if self.ce is not None:
             self.ce.register_ctl(_CTL_OP, self._on_ctl)
 
@@ -846,11 +852,29 @@ class ExecutableCache:
                 blob = bytes(exp.serialize())
                 callconv = _callconv_of(exp)
             except Exception as e:
+                # the graceful process-local path: the program still gets
+                # the per-process LRU (and, where jit's own lowering can
+                # be reused, the XLA persistent cache) — but NOT the disk
+                # store or the compile broadcast.  Count it
+                # (PARSEC::COMPILE::LOCAL_ONLY / parsec_compile_local_
+                # only_total) so a mesh silently paying per-rank Pallas
+                # compiles is visible, and name the program once.
                 self.stats["serialize_errors"] += 1
-                debug.verbose(1, "compile_cache",
-                              "program %r not serializable (%s: %s); "
-                              "staying process-local", _short(cf.key),
-                              type(e).__name__, e)
+                self.stats["local_only"] += 1
+                kshort = _short(cf.key)
+                if kshort not in self._local_only_warned:
+                    self._local_only_warned.add(kshort)
+                    debug.warning(
+                        "compile cache: program %r is not exportable "
+                        "(%s: %s); it stays process-local — no disk "
+                        "store, no compile broadcast (counted in "
+                        "PARSEC::COMPILE::LOCAL_ONLY)", kshort,
+                        type(e).__name__, e)
+                else:
+                    debug.verbose(1, "compile_cache",
+                                  "program %r not serializable (%s: %s); "
+                                  "staying process-local", kshort,
+                                  type(e).__name__, e)
             if blob is not None \
                     and time.perf_counter() - t0 >= self.min_disk_s:
                 exe = self._share_blob(cf, fp, args, blob, callconv, t0)

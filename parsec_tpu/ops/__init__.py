@@ -1,6 +1,16 @@
 """Compute bodies (tile kernels) and flagship taskpools."""
 
 from . import tiles
+from .attention import (
+    attention_task_count,
+    build_flash_attention,
+    flash_attention_ptg,
+    ring_attention_ptg,
+    ring_attention_builder,
+    run_flash_attention,
+    run_flash_attention_native,
+    run_ring_attention_graph,
+)
 from .cholesky import cholesky_ptg, run_cholesky
 from .lu import lu_ptg, run_lu
 from .panel_chol import PanelCholesky, WholeCholesky
@@ -10,6 +20,10 @@ from .segmented_qr import SegmentedQR, segmented_qr_ptg
 from .qr import qr_ptg, run_qr
 
 __all__ = ["tiles", "cholesky_ptg", "run_cholesky", "lu_ptg", "run_lu",
+           "flash_attention_ptg", "ring_attention_ptg",
+           "build_flash_attention", "run_flash_attention",
+           "run_flash_attention_native", "run_ring_attention_graph",
+           "ring_attention_builder", "attention_task_count",
            "PanelCholesky", "WholeCholesky",
            "SegmentedCholesky", "segmented_cholesky_ptg",
            "SegmentedLU", "segmented_lu_ptg",

@@ -1,0 +1,695 @@
+"""Attention as a first-class task graph — runtime-native flash / ring
+attention (ROADMAP item 4).
+
+Until this module, attention lived only in
+:mod:`parsec_tpu.parallel.ring_attention` as a hand-written SPMD
+``shard_map`` loop — one monolithic jitted program that bypasses
+everything the runtime learned in PRs 3–9 (native dispatch, the
+eager/rendezvous wire protocol, the compile cache, the serving plane).
+"FlatAttention" (PAPERS.md) argues multi-head-attention dataflow and
+fabric collectives must be co-designed on tile-based many-PE hardware —
+exactly the runtime's shape — so here the same numerics become ordinary
+PTG dataflow:
+
+* :func:`flash_attention_ptg` — single-rank **blockwise flash
+  attention**: task class ``attn_step(g, i, s)`` threads the online-
+  softmax carry ``(acc, m, l)`` of query block ``i`` (group ``g`` = one
+  (batch, head) plane) through the KV blocks ``s``; the device chore is
+  the existing fused Pallas tile kernel
+  (:func:`parsec_tpu.ops.pallas_kernels.flash_attention_block`), jitted
+  through the PR 7 :class:`~parsec_tpu.compile_cache.ExecutableCache`
+  and dispatchable through the PR 3 ASYNC native path
+  (``tp.run_native(native_device=True)``).  ``attn_out(g, i)``
+  normalizes ``acc / l`` into the output block.
+
+* :func:`ring_attention_ptg` — **distributed ring attention**: each
+  rank owns one query block and one resident K/V block; per step ``s``
+  rank ``r`` computes against K/V block ``(r + s) % R`` and forwards it
+  one neighbor hop (``variant="ring"``, the K/V rotation expressed as
+  ordinary remote dependencies riding the PR 4 eager/rdv chunked
+  protocol — step ``s`` compute overlaps step ``s+1``'s K/V transfer,
+  measurable with the PR 1 per-rank overlap metric).  ``variant="bcast"``
+  reindexes the carry chain by KV block and lets each owner broadcast
+  its block down the runtime's activation tree instead (the non-causal
+  case, where accumulation order is free).
+
+Block sizes accept ``"auto"``: resolved against the tuning store
+(:func:`parsec_tpu.tuning.autotune_attention` / ``tools autotune
+--attention``) per (seq length, dtype, device generation) in the spirit
+of "Design in Tiles" (PAPERS.md).
+
+The numerics oracle for every path remains
+:func:`parsec_tpu.parallel.attention_reference`.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.lifecycle import AccessMode
+from ..data.collection import DataCollection
+from ..data.data import Data, data_create
+from ..dsl.ptg import PTG
+
+try:
+    import jax
+    import jax.numpy as jnp
+except Exception:  # pragma: no cover
+    jax = None
+
+IN = AccessMode.IN
+INOUT = AccessMode.INOUT
+
+#: finite "-inf" used to initialise the running max ``m`` (matches the
+#: SPMD path's ``_NEG_BIG``; keeps ``exp()`` NaN-free on fully-masked
+#: causal blocks)
+NEG_BIG = -1e30
+
+
+def block_splits(n: int, block: int) -> List[Tuple[int, int]]:
+    """``(offset, size)`` per block of an ``n``-long axis; the tail block
+    is ragged when ``block`` does not divide ``n``."""
+    if block <= 0:
+        raise ValueError(f"block size must be positive (got {block})")
+    return [(o, min(block, n - o)) for o in range(0, n, block)]
+
+
+# ---------------------------------------------------------------------------
+# collections: per-(group, block) planes of a [B, S, H, D] tensor
+# ---------------------------------------------------------------------------
+
+class PlaneCollection(DataCollection):
+    """Lazily-materialised planes keyed ``(g, j)`` — group ``g`` is one
+    (batch, head) pair, ``j`` a sequence-block index.  ``init(g, j)``
+    builds the tile; ``rank_of`` (optional) distributes block ``j``
+    (ring attention places block ``j`` on rank ``j``)."""
+
+    def __init__(self, name: str, init: Callable[[int, int], np.ndarray],
+                 *, keys: Sequence[Tuple[int, int]] = (),
+                 nodes: int = 1, myrank: int = 0,
+                 rank_of: Optional[Callable[[int, int], int]] = None):
+        super().__init__(name, nodes=nodes, myrank=myrank)
+        self._init = init
+        self._keys = [tuple(k) for k in keys]
+        self._rank_of = rank_of
+        self._store: Dict[Tuple[int, int], Data] = {}
+        self._lock = threading.Lock()
+
+    def data_key(self, *key):
+        if len(key) == 1 and isinstance(key[0], tuple):
+            key = key[0]
+        g, j = key
+        return (int(g), int(j))
+
+    def rank_of(self, *key) -> int:
+        if self._rank_of is None:
+            return 0
+        g, j = self.data_key(*key)
+        return self._rank_of(g, j)
+
+    def data_of(self, *key) -> Data:
+        k = self.data_key(*key)
+        with self._lock:
+            d = self._store.get(k)
+            if d is None:
+                d = data_create(k, self,
+                                payload=np.asarray(self._init(*k)))
+                self._store[k] = d
+            return d
+
+    def tiles(self):
+        return list(self._keys)
+
+    def local_tiles(self):
+        """Declared keys owned by this rank — the explorer's
+        :func:`~parsec_tpu.analysis.schedules.tile_digest` currency."""
+        for key in self._keys:
+            if self.rank_of(*key) == self.myrank:
+                yield key
+
+
+# ---------------------------------------------------------------------------
+# task bodies (device = the fused Pallas kernel; cpu = numpy fallback)
+# ---------------------------------------------------------------------------
+
+def _make_step_body_tpu(q_block: int, kv_block: int, causal: bool,
+                        scale: float, interpret, q_offset: int):
+    from .pallas_kernels import flash_attention_block
+
+    def attn_step(QB, KB, VB, ACC, M, L, **kw):
+        i, s = kw["i"], kw["s"]
+        acc, m, l = flash_attention_block(
+            QB, KB, VB, ACC, M, L,
+            q_offset + i * q_block, s * kv_block,
+            causal=causal, scale=float(scale), interpret=interpret)
+        return acc, m, l
+
+    attn_step._jit_key = ("attn_step", q_block, kv_block, causal,
+                          float(scale), interpret, q_offset)
+    return attn_step
+
+
+def _np_step(QB, KB, VB, ACC, M, L, q_off: int, k_off: int,
+             causal: bool, scale: float) -> None:
+    """One in-place numpy online-softmax block update (the CPU
+    incarnation; mirrors the kernel's -inf masking discipline)."""
+    logits = (QB.astype(np.float32) @ KB.astype(np.float32).T) * scale
+    if causal:
+        qpos = q_off + np.arange(logits.shape[0])[:, None]
+        kpos = k_off + np.arange(logits.shape[1])[None, :]
+        logits = np.where(qpos >= kpos, logits, -np.inf)
+    m_new = np.maximum(M, logits.max(axis=-1, keepdims=True))
+    p = np.exp(logits - m_new)          # -inf - finite -> 0 exactly
+    corr = np.exp(M - m_new)
+    L *= corr
+    L += p.sum(axis=-1, keepdims=True)
+    ACC *= corr
+    ACC += p @ VB.astype(np.float32)
+    M[:] = m_new
+
+
+def _make_step_body_cpu(q_block: int, kv_block: int, causal: bool,
+                        scale: float, q_offset: int):
+    def attn_step(QB, KB, VB, ACC, M, L, **kw):
+        i, s = kw["i"], kw["s"]
+        _np_step(QB, KB, VB, ACC, M, L, q_offset + i * q_block,
+                 s * kv_block, causal, scale)
+
+    return attn_step
+
+
+def _make_ring_step_body_tpu(q_block: int, kv_block: int, causal: bool,
+                             scale: float, interpret, block_rem: int):
+    from .pallas_kernels import flash_attention_block
+
+    def attn_rstep(QB, KB, VB, ACC, M, L, **kw):
+        # balanced splits: the first block_rem blocks are one row
+        # taller, so block idx starts at idx*base + min(idx, rem)
+        # (r / ki arrive as traced scalars — jnp handles both)
+        r, ki = kw["r"], kw["ki"]
+        q_off = r * q_block + jnp.minimum(r, block_rem)
+        k_off = ki * kv_block + jnp.minimum(ki, block_rem)
+        acc, m, l = flash_attention_block(
+            QB, KB, VB, ACC, M, L, q_off, k_off,
+            causal=causal, scale=float(scale), interpret=interpret)
+        return acc, m, l
+
+    attn_rstep._jit_key = ("attn_rstep", q_block, kv_block, block_rem,
+                           causal, float(scale), interpret)
+    return attn_rstep
+
+
+def _make_ring_step_body_cpu(q_block: int, kv_block: int, causal: bool,
+                             scale: float, block_rem: int):
+    def attn_rstep(QB, KB, VB, ACC, M, L, **kw):
+        r, ki = kw["r"], kw["ki"]
+        _np_step(QB, KB, VB, ACC, M, L,
+                 r * q_block + min(r, block_rem),
+                 ki * kv_block + min(ki, block_rem), causal, scale)
+
+    return attn_rstep
+
+
+def _attn_out_tpu(ACC, M, L, O, **_):
+    return (ACC / L).astype(O.dtype)
+
+
+_attn_out_tpu._jit_key = ("attn_out",)
+
+
+def _attn_out_cpu(ACC, M, L, O, **_):
+    O[:] = (ACC / L).astype(O.dtype)
+
+
+def _kvsrc_tpu(KB, VB, **_):
+    return ()  # pure forward: no writable flows
+
+
+_kvsrc_tpu._jit_key = ("attn_kvsrc",)
+
+
+def _kvsrc_cpu(KB, VB, **_):
+    pass
+
+
+def _bodies(pc, tpu_body, cpu_body, use_tpu: bool, use_cpu: bool) -> None:
+    kw = {}
+    if use_tpu and tpu_body is not None:
+        kw["tpu"] = tpu_body
+    if use_cpu:
+        kw["cpu"] = cpu_body
+    if not kw:
+        raise ValueError(
+            f"{pc.name}: no BODY selected (use_tpu={use_tpu} needs jax; "
+            f"use_cpu={use_cpu})")
+    pc.body(**kw)
+
+
+# ---------------------------------------------------------------------------
+# the graphs
+# ---------------------------------------------------------------------------
+
+#: per-query-block causal horizon: the LAST kv-block index whose span
+#: intersects query block i's allowed region — blocks beyond it are
+#: entirely above the diagonal and their online-softmax update is a
+#: provable no-op (p == 0, corr == 1), so causal graphs do not even
+#: instantiate those step tasks.  Needs the taskpool constants QB / KVB
+#: / QOFF / SQ next to NK.
+_CAUSAL_HZ = "min(NK-1, (QOFF + min((i+1)*QB, SQ) - 1) // KVB)"
+
+
+def flash_attention_ptg(*, causal: bool = False, scale: float = 1.0,
+                        q_block: int = 128, kv_block: int = 128,
+                        q_offset: int = 0,
+                        use_tpu: bool = True, use_cpu: bool = True,
+                        interpret: Optional[bool] = None) -> PTG:
+    """Single-rank blockwise flash attention.  Instantiate with
+    ``.taskpool(G=, NQ=, NK=, QB=, KVB=, QOFF=, SQ=, Q=, K=, V=, O=,
+    CA=, CM=, CL=)`` where the collections are keyed ``(g, block)``:
+    ``Q(g, i)``/``O(g, i)`` are ``(sq_i, D)`` query/output blocks,
+    ``K(g, s)``/``V(g, s)`` are ``(sk_s, D)`` KV blocks, and
+    ``CA``/``CM``/``CL`` hold the per-query-block carry initials
+    (zeros, ``NEG_BIG``, zeros); the scalar constants repeat the block
+    geometry (``QB``/``KVB`` block sizes, ``QOFF`` global query offset,
+    ``SQ`` query length) so the causal step range can stop at each
+    block's horizon.  ``q_offset`` shifts the global query positions
+    (decode: queries live at the tail of the KV sequence).
+    :func:`build_flash_attention` assembles all of this from
+    ``[B, S, H, D]`` arrays."""
+    ptg = PTG("flash_attn")
+
+    # hz = last kv step of query block i: causal graphs stop the carry
+    # chain at the diagonal block instead of dispatching no-op tasks
+    st = ptg.task_class("attn_step", g="0 .. G-1", i="0 .. NQ-1")
+    st.define("hz", _CAUSAL_HZ if causal else "NK-1")
+    st.param("s", "0 .. hz")
+    st.affinity("Q(g, i)")
+    st.priority("NK - s")  # drain each carry chain front-first
+    st.flow("QB", IN, "<- Q(g, i)")
+    st.flow("KB", IN, "<- K(g, s)")
+    st.flow("VB", IN, "<- V(g, s)")
+    for name, coll in (("ACC", "CA"), ("M", "CM"), ("L", "CL")):
+        st.flow(name, INOUT,
+                f"<- (s == 0) ? {coll}(g, i) : {name} attn_step(g, i, s-1)",
+                f"-> (s < hz) ? {name} attn_step(g, i, s+1) "
+                f": {name} attn_out(g, i)")
+    _bodies(st,
+            _make_step_body_tpu(q_block, kv_block, causal, scale,
+                                interpret, q_offset) if jax else None,
+            _make_step_body_cpu(q_block, kv_block, causal, scale,
+                                q_offset),
+            use_tpu, use_cpu)
+
+    out = ptg.task_class("attn_out", g="0 .. G-1", i="0 .. NQ-1")
+    out.define("hz", _CAUSAL_HZ if causal else "NK-1")
+    out.affinity("Q(g, i)")
+    out.priority("0")
+    out.flow("ACC", IN, "<- ACC attn_step(g, i, hz)")
+    out.flow("M", IN, "<- M attn_step(g, i, hz)")
+    out.flow("L", IN, "<- L attn_step(g, i, hz)")
+    out.flow("O", INOUT, "<- O(g, i)", "-> O(g, i)")
+    _bodies(out, _attn_out_tpu if jax else None, _attn_out_cpu,
+            use_tpu, use_cpu)
+    return ptg
+
+
+def ring_attention_ptg(*, causal: bool = False, scale: float = 1.0,
+                       q_block: int = 128, kv_block: int = 128,
+                       block_rem: int = 0,
+                       variant: str = "ring",
+                       use_tpu: bool = True, use_cpu: bool = True,
+                       interpret: Optional[bool] = None) -> PTG:
+    """Distributed ring attention over ``R`` ranks: rank ``r`` owns query
+    block ``r`` and (initially) K/V block ``r``; instantiate with
+    ``.taskpool(G=, R=, Q=, K=, V=, O=, CA=, CM=, CL=)`` where the
+    collections place block ``j`` on rank ``j`` (``rank_of``).
+
+    ``variant="ring"``: step ``s`` of rank ``r`` computes against K/V
+    block ``ki = (r + s) % R``, received from neighbor ``(r + 1) % R``'s
+    step ``s-1`` and forwarded to ``(r - 1) % R``'s step ``s+1`` — the
+    rotation is nothing but remote dependencies, so the payloads ride
+    the eager/rendezvous chunked protocol and the transfer of step
+    ``s+1``'s block overlaps step ``s``'s compute.
+
+    ``variant="bcast"``: the carry chain is reindexed by KV block
+    (``attn_bstep(g, r, j)`` consumes block ``j`` directly from its
+    owner's ``attn_kvsrc(g, j)`` forward task, one ranged output dep =
+    the runtime's activation broadcast tree).  Accumulation order is
+    block order on every rank; correct for causal too, but built for
+    the non-causal case where order is free.
+
+    ``block_rem``: with balanced splits of a non-dividing sequence the
+    first ``block_rem`` blocks are one row taller; the step bodies
+    compute global offsets as ``idx*block + min(idx, block_rem)``.
+    Unlike the flash graph, causal ring graphs keep their fully-masked
+    steps: the block must still TRANSIT the rank to reach later
+    consumers on the rotation path, and a masked block's kernel update
+    is exactly the identity on the carry."""
+    if variant not in ("ring", "bcast"):
+        raise ValueError(f"unknown ring-attention variant {variant!r} "
+                         "(expected 'ring' or 'bcast')")
+    ptg = PTG(f"ring_attn_{variant}")
+    tpu_step = _make_ring_step_body_tpu(
+        q_block, kv_block, causal, scale, interpret,
+        block_rem) if jax else None
+    cpu_step = _make_ring_step_body_cpu(q_block, kv_block, causal, scale,
+                                        block_rem)
+
+    if variant == "ring":
+        st = ptg.task_class("attn_rstep", g="0 .. G-1", r="0 .. R-1",
+                            s="0 .. R-1")
+        st.define("ki", "(r + s) % R")
+        st.affinity("Q(g, r)")
+        st.priority("(R - s) * 10")
+        st.flow("QB", IN, "<- Q(g, r)")
+        # the rotation: K/V blocks hop one neighbor per step.  `s` is the
+        # step index, so the producing neighbor is always its step s-1 —
+        # reciprocity holds under the modular index arithmetic.
+        st.flow("KB", IN,
+                "<- (s == 0) ? K(g, r) : KB attn_rstep(g, (r+1) % R, s-1)",
+                "-> (s < R-1) ? KB attn_rstep(g, (r-1) % R, s+1)")
+        st.flow("VB", IN,
+                "<- (s == 0) ? V(g, r) : VB attn_rstep(g, (r+1) % R, s-1)",
+                "-> (s < R-1) ? VB attn_rstep(g, (r-1) % R, s+1)")
+        for name, coll in (("ACC", "CA"), ("M", "CM"), ("L", "CL")):
+            st.flow(name, INOUT,
+                    f"<- (s == 0) ? {coll}(g, r) "
+                    f": {name} attn_rstep(g, r, s-1)",
+                    f"-> (s < R-1) ? {name} attn_rstep(g, r, s+1) "
+                    f": {name} attn_out(g, r)")
+        _bodies(st, tpu_step, cpu_step, use_tpu, use_cpu)
+        step_name = "attn_rstep"
+    else:
+        # bcast variant: every rank's carry visits KV blocks in block
+        # order j, each block broadcast once by its owner's forward task
+        src = ptg.task_class("attn_kvsrc", g="0 .. G-1", j="0 .. R-1")
+        src.affinity("K(g, j)")
+        src.priority("1000")  # ship KV blocks before anything computes
+        src.flow("KB", IN, "<- K(g, j)",
+                 "-> KB attn_bstep(g, 0 .. R-1, j)")
+        src.flow("VB", IN, "<- V(g, j)",
+                 "-> VB attn_bstep(g, 0 .. R-1, j)")
+        _bodies(src, _kvsrc_tpu if jax else None, _kvsrc_cpu,
+                use_tpu, use_cpu)
+
+        st = ptg.task_class("attn_bstep", g="0 .. G-1", r="0 .. R-1",
+                            j="0 .. R-1")
+        st.define("ki", "j")
+        st.affinity("Q(g, r)")
+        st.priority("(R - j) * 10")
+        st.flow("QB", IN, "<- Q(g, r)")
+        st.flow("KB", IN, "<- KB attn_kvsrc(g, j)")
+        st.flow("VB", IN, "<- VB attn_kvsrc(g, j)")
+        for name, coll in (("ACC", "CA"), ("M", "CM"), ("L", "CL")):
+            st.flow(name, INOUT,
+                    f"<- (j == 0) ? {coll}(g, r) "
+                    f": {name} attn_bstep(g, r, j-1)",
+                    f"-> (j < R-1) ? {name} attn_bstep(g, r, j+1) "
+                    f": {name} attn_out(g, r)")
+        _bodies(st, tpu_step, cpu_step, use_tpu, use_cpu)
+        step_name = "attn_bstep"
+
+    last = "R-1"
+    out = ptg.task_class("attn_out", g="0 .. G-1", r="0 .. R-1")
+    out.affinity("Q(g, r)")
+    out.priority("0")
+    out.flow("ACC", IN, f"<- ACC {step_name}(g, r, {last})")
+    out.flow("M", IN, f"<- M {step_name}(g, r, {last})")
+    out.flow("L", IN, f"<- L {step_name}(g, r, {last})")
+    out.flow("O", INOUT, "<- O(g, r)", "-> O(g, r)")
+    _bodies(out, _attn_out_tpu if jax else None, _attn_out_cpu,
+            use_tpu, use_cpu)
+    return ptg
+
+
+# ---------------------------------------------------------------------------
+# builders / drivers
+# ---------------------------------------------------------------------------
+
+def _resolve_block(value, op_param: str, seq: int, dtype) -> int:
+    """``"auto"`` resolves against the tuning store (op ``attention``,
+    param ``q_block``/``kv_block``, keyed on the sequence length and
+    device generation); explicit values pass through."""
+    if value != "auto":
+        return int(value)
+    from .. import tuning
+
+    default = min(128, seq)
+    return int(tuning.resolve_nb("attention", seq, dtype,
+                                 param=op_param, default=default) or default)
+
+
+def _carry_inits(D: int, q_sizes: Sequence[int]):
+    """(CA, CM, CL) init callables for the per-query-block carries."""
+    def ca(g, i):
+        return np.zeros((q_sizes[i], D), np.float32)
+
+    def cm(g, i):
+        return np.full((q_sizes[i], 1), NEG_BIG, np.float32)
+
+    def cl(g, i):
+        return np.zeros((q_sizes[i], 1), np.float32)
+
+    return ca, cm, cl
+
+
+def build_flash_attention(q, k, v, *, causal: bool = False,
+                          scale: Optional[float] = None,
+                          q_block="auto", kv_block="auto",
+                          q_offset: Optional[int] = None,
+                          use_tpu: bool = True, use_cpu: bool = True,
+                          interpret: Optional[bool] = None,
+                          out_dtype=None):
+    """Build the single-rank flash-attention taskpool for ``[B, S, H, D]``
+    arrays (``q`` may be shorter than ``k``/``v`` — the decode shape).
+    Returns ``(taskpool, assemble)`` where ``assemble()`` reads the
+    output collection back into one ``[B, Sq, H, D]`` array after the
+    pool quiesced.
+
+    ``q_offset`` is the global position of query row 0 for the causal
+    mask; it defaults to ``Sk - Sq`` (decode semantics: the queries are
+    the tail of the KV sequence)."""
+    q = np.asarray(q)
+    k = np.asarray(k)
+    v = np.asarray(v)
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    if k.shape != (B, Sk, H, D) or v.shape != (B, Sk, H, D):
+        raise ValueError(f"shape mismatch: q {q.shape}, k {k.shape}, "
+                         f"v {v.shape}")
+    scale_v = scale if scale is not None else 1.0 / math.sqrt(D)
+    if q_offset is None:
+        q_offset = Sk - Sq
+    if causal and q_offset < 0:
+        # a negative offset puts leading query rows BEFORE every key
+        # position: those rows are fully masked, their normalizer l
+        # stays 0 and attn_out would return silent 0/0 NaNs — the
+        # usual cause is swapped prefill arguments (Sq > Sk)
+        raise ValueError(
+            f"causal attention with q_offset={q_offset} < 0 (Sq={Sq} > "
+            f"Sk={Sk}?): leading query rows would attend to nothing; "
+            "pass q/k/v with Sq <= Sk or an explicit q_offset >= 0")
+    qb = _resolve_block(q_block, "q_block", Sq, q.dtype)
+    kvb = _resolve_block(kv_block, "kv_block", Sk, q.dtype)
+    qs = block_splits(Sq, qb)
+    ks = block_splits(Sk, kvb)
+    G = B * H
+    odt = np.dtype(out_dtype) if out_dtype is not None else q.dtype
+
+    def plane(arr, splits):
+        def init(g, j):
+            b, h = divmod(g, H)
+            o, n = splits[j]
+            return np.ascontiguousarray(arr[b, o:o + n, h, :])
+        return init
+
+    keys_q = [(g, i) for g in range(G) for i in range(len(qs))]
+    keys_k = [(g, s) for g in range(G) for s in range(len(ks))]
+    Qc = PlaneCollection("Q", plane(q, qs), keys=keys_q)
+    Kc = PlaneCollection("K", plane(k, ks), keys=keys_k)
+    Vc = PlaneCollection("V", plane(v, ks), keys=keys_k)
+    Oc = PlaneCollection(
+        "O", lambda g, i: np.zeros((qs[i][1], D), odt), keys=keys_q)
+    ca, cm, cl = _carry_inits(D, [n for _, n in qs])
+    tp = flash_attention_ptg(
+        causal=causal, scale=scale_v, q_block=qb, kv_block=kvb,
+        q_offset=q_offset, use_tpu=use_tpu, use_cpu=use_cpu,
+        interpret=interpret,
+    ).taskpool(G=G, NQ=len(qs), NK=len(ks), QB=qb, KVB=kvb,
+               QOFF=q_offset, SQ=Sq,
+               Q=Qc, K=Kc, V=Vc, O=Oc,
+               CA=PlaneCollection("CA", ca, keys=keys_q),
+               CM=PlaneCollection("CM", cm, keys=keys_q),
+               CL=PlaneCollection("CL", cl, keys=keys_q))
+
+    def assemble() -> np.ndarray:
+        out = np.zeros((B, Sq, H, D), odt)
+        for g in range(G):
+            b, h = divmod(g, H)
+            for i, (o, n) in enumerate(qs):
+                c = Oc.data_of(g, i).newest_copy()
+                out[b, o:o + n, h, :] = np.asarray(c.payload)
+        return out
+
+    return tp, assemble
+
+
+def attention_task_count(B: int, Sq: int, Sk: int, H: int,
+                         q_block: int, kv_block: int, *,
+                         causal: bool = False,
+                         q_offset: Optional[int] = None) -> int:
+    """Task count of the flash graph: per query block, one step per kv
+    block up to its causal horizon (non-causal: all NK), plus the
+    normalize task — G * (sum_i (hz_i + 1) + NQ)."""
+    if q_offset is None:
+        q_offset = Sk - Sq
+    nq = (Sq + q_block - 1) // q_block
+    nk = (Sk + kv_block - 1) // kv_block
+    steps = 0
+    for i in range(nq):
+        hz = nk - 1
+        if causal:
+            hz = min(hz, (q_offset + min((i + 1) * q_block, Sq) - 1)
+                     // kv_block)
+        steps += hz + 1
+    return B * H * (steps + nq)
+
+
+def run_flash_attention(context, q, k, v, *, timeout: float = 600,
+                        **kw) -> np.ndarray:
+    """Blockwise flash attention through a live context's dynamic
+    runtime; returns the ``[B, Sq, H, D]`` output."""
+    tp, assemble = build_flash_attention(q, k, v, **kw)
+    context.add_taskpool(tp)
+    if not tp.wait(timeout=timeout):
+        raise RuntimeError("flash-attention taskpool did not quiesce")
+    return assemble()
+
+
+def run_flash_attention_native(q, k, v, *, nthreads: int = 4,
+                               device=None, **kw) -> np.ndarray:
+    """Same graph through the native C++ engine with ASYNC device
+    chores (PR 3): scheduling and successor release never enter the
+    interpreter; the Pallas step kernel compiles through the executable
+    cache exactly as on the dynamic path."""
+    for bad in ("use_cpu", "timeout"):
+        if bad in kw:
+            raise ValueError(
+                f"run_flash_attention_native does not take {bad!r} "
+                "(device chores only, runs to quiescence); use "
+                "run_flash_attention for CPU bodies or timeouts")
+    tp, assemble = build_flash_attention(q, k, v, use_cpu=False, **kw)
+    tp.run_native(nthreads=nthreads, native_device=True, device=device)
+    return assemble()
+
+
+def ring_attention_builder(nranks: int, q, k, v, *,
+                           causal: bool = False,
+                           scale: Optional[float] = None,
+                           variant: str = "ring",
+                           use_tpu: bool = True, use_cpu: bool = True,
+                           interpret: Optional[bool] = None):
+    """The per-rank builder of the distributed ring-attention PTG — the
+    ``build(rank, ctx) -> (taskpool, O-collection)`` shape shared by
+    :func:`~parsec_tpu.multirank.run_multirank_perf` and the schedule
+    explorer (:func:`parsec_tpu.analysis.schedules.explore`).  Returns
+    ``(build, assemble)``; call ``assemble(users)`` on the per-rank O
+    collections after quiescence for the ``[B, S, H, D]`` output."""
+    q = np.asarray(q)
+    k = np.asarray(k)
+    v = np.asarray(v)
+    B, S, H, D = q.shape
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError("ring attention needs equal q/k/v shapes")
+    scale_v = scale if scale is not None else 1.0 / math.sqrt(D)
+    # one block per rank, BALANCED: the first S%R ranks get one extra
+    # row (a ceil-sized split can yield fewer blocks than ranks — e.g.
+    # S=9, R=4 — so it cannot cover every S >= R)
+    base, rem = divmod(S, nranks)
+    if base == 0:
+        raise ValueError(f"S={S} < nranks={nranks}: every rank needs at "
+                         "least one sequence row")
+    splits = [(r * base + min(r, rem), base + (1 if r < rem else 0))
+              for r in range(nranks)]
+    G = B * H
+    keys = [(g, r) for g in range(G) for r in range(nranks)]
+    ptg = ring_attention_ptg(causal=causal, scale=scale_v, q_block=base,
+                             kv_block=base, block_rem=rem,
+                             variant=variant,
+                             use_tpu=use_tpu, use_cpu=use_cpu,
+                             interpret=interpret)
+    sizes = [n for _, n in splits]
+
+    def build(r, ctx):
+        def plane(arr):
+            def init(g, j):
+                b, h = divmod(g, H)
+                o, n = splits[j]
+                return np.ascontiguousarray(arr[b, o:o + n, h, :])
+            return init
+
+        owner = dict(nodes=nranks, myrank=r,
+                     rank_of=lambda g, j: j % nranks)
+        Oc = PlaneCollection(
+            "O", lambda g, i: np.zeros((sizes[i], D), q.dtype),
+            keys=keys, **owner)
+        ca, cm, cl = _carry_inits(D, sizes)
+        tp = ptg.taskpool(
+            G=G, R=nranks,
+            Q=PlaneCollection("Q", plane(q), keys=keys, **owner),
+            K=PlaneCollection("K", plane(k), keys=keys, **owner),
+            V=PlaneCollection("V", plane(v), keys=keys, **owner),
+            O=Oc,
+            CA=PlaneCollection("CA", ca, keys=keys, **owner),
+            CM=PlaneCollection("CM", cm, keys=keys, **owner),
+            CL=PlaneCollection("CL", cl, keys=keys, **owner))
+        return tp, Oc
+
+    def assemble(users) -> np.ndarray:
+        out = np.zeros((B, S, H, D), q.dtype)
+        for r, oc in enumerate(users):
+            o, n = splits[r]
+            for g in range(G):
+                b, h = divmod(g, H)
+                c = oc.data_of(g, r).newest_copy()
+                out[b, o:o + n, h, :] = np.asarray(c.payload)
+        return out
+
+    return build, assemble
+
+
+def run_ring_attention_graph(nranks: int, q, k, v, *,
+                             causal: bool = False,
+                             scale: Optional[float] = None,
+                             variant: str = "ring",
+                             use_tpu: bool = True, use_cpu: bool = True,
+                             interpret: Optional[bool] = None,
+                             fabric=None, nb_cores: int = 2,
+                             timeout: float = 300,
+                             trace_pins: bool = False,
+                             trace_dir: Optional[str] = None):
+    """Drive the distributed ring-attention PTG over ``nranks`` inproc
+    ranks (one Context per rank; K/V rotation = remote deps on the
+    fabric).  ``q``/``k``/``v`` are full ``[B, S, H, D]`` arrays; block
+    ``r`` of every plane lives on rank ``r``.  Returns
+    ``(out, stats)`` — ``stats`` is the
+    :func:`~parsec_tpu.multirank.run_multirank_perf` record; with
+    ``trace_pins`` it includes the per-rank comm/compute overlap
+    metrics, so the rotation's transfer-behind-compute pipelining is
+    measurable, not aspirational."""
+    from ..multirank import run_multirank_perf
+
+    q = np.asarray(q)
+    B, S, H, D = q.shape
+    build, assemble = ring_attention_builder(
+        nranks, q, k, v, causal=causal, scale=scale, variant=variant,
+        use_tpu=use_tpu, use_cpu=use_cpu, interpret=interpret)
+    flops = 4.0 * B * H * S * S * D
+    users, stats = run_multirank_perf(
+        nranks, build, nb_cores=nb_cores, timeout=timeout, fabric=fabric,
+        overlap=trace_pins, flops=flops, trace_dir=trace_dir)
+    return assemble(users), stats

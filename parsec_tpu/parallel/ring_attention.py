@@ -23,6 +23,15 @@ collectives ride ICI:
 
 Both operate on ``[batch, seq, heads, head_dim]`` arrays sequence-sharded
 over one mesh axis and return the same layout.
+
+These are single-program SPMD loops compiled by XLA; the RUNTIME-native
+formulation — the same numerics as PTG task graphs whose K/V rotation
+rides the eager/rendezvous wire protocol, dispatched through the native
+ASYNC path and servable as batched-inference taskpools — lives in
+:mod:`parsec_tpu.ops.attention` (USERGUIDE §13).  The two are
+bit-compared at matching precision in
+``tests/runtime/test_attention_ring.py``; :func:`attention_reference`
+here remains the numerics oracle for both.
 """
 
 from __future__ import annotations

@@ -50,6 +50,25 @@ COMPILE_SPAN_NAMES = ("compile",)
 #: attribution (comm/coll.py fires them; binary traces record them)
 COLL_SPAN_NAMES = ("coll",)
 
+#: workload labels: task-class names (exact, or by prefix) aggregate
+#: into a ``per_label`` section next to ``per_class`` — e.g. every
+#: attention class (``attn_step``/``attn_rstep``/``attn_out``/…) rolls
+#: up under one ``attention`` row, so "how much of the chain is
+#: attention" reads off one line however many classes the graph has
+CLASS_LABELS: Dict[str, str] = {}
+PREFIX_LABELS: Tuple[Tuple[str, str], ...] = (("attn_", "attention"),)
+
+
+def label_of(cls: str) -> Optional[str]:
+    """Workload label of a task-class name, or None."""
+    lab = CLASS_LABELS.get(cls)
+    if lab is not None:
+        return lab
+    for prefix, lab in PREFIX_LABELS:
+        if cls.startswith(prefix):
+            return lab
+    return None
+
 
 def _merge_intervals(iv: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
     iv.sort()
@@ -181,8 +200,8 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
              "buckets": {"compute_us": 0.0, "comm_us": 0.0,
                          "coll_us": 0.0, "compile_us": 0.0,
                          "host_gap_us": 0.0},
-             "per_class": {}, "per_tenant": {}, "chain": [],
-             "comm_regimes": regimes}
+             "per_class": {}, "per_label": {}, "per_tenant": {},
+             "chain": [], "comm_regimes": regimes}
     if not tasks:
         return empty
     comm_merged = {pid: _merge_intervals(iv) for pid, iv in comm_iv.items()}
@@ -265,12 +284,25 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
         prev_end = max(t["end"], prev_end or t["end"])
     wall = tasks[chain[-1]]["end"] - tasks[chain[0]]["begin"]
     attributed = sum(buckets.values())
+    # workload rollup: per_class rows aggregated by label (label_of) —
+    # the `attention` bucket of the attention graphs lives here
+    per_label: Dict[str, Dict[str, float]] = {}
+    for cls, pc in per_class.items():
+        lab = label_of(cls)
+        if lab is None:
+            continue
+        agg = per_label.setdefault(
+            lab, {"count": 0, "compute_us": 0.0, "comm_us": 0.0,
+                  "coll_us": 0.0, "compile_us": 0.0, "host_gap_us": 0.0})
+        for key in agg:
+            agg[key] += pc[key]
     return {
         "wall_us": wall,
         "n_tasks": len(chain),
         "coverage": (attributed / wall) if wall > 0 else 0.0,
         "buckets": buckets,
         "per_class": {k: dict(v) for k, v in per_class.items()},
+        "per_label": per_label,
         "per_tenant": {k: dict(v) for k, v in per_tenant.items()},
         "chain": rows,
         "comm_regimes": regimes,
@@ -310,6 +342,16 @@ def render(report: dict) -> str:
                 f"{pc['compute_us'] / 1e3:>12.3f}"
                 f"{pc['comm_us'] / 1e3:>10.3f}"
                 f"{pc['host_gap_us'] / 1e3:>10.3f}{per_task:>14.1f}")
+    if report.get("per_label"):
+        lines.append(f"  {'label':<18}{'count':>6}{'compute_ms':>12}"
+                     f"{'comm_ms':>10}{'host_ms':>10}")
+        for lab in sorted(report["per_label"]):
+            pl = report["per_label"][lab]
+            lines.append(
+                f"  {lab:<18}{pl['count']:>6}"
+                f"{pl['compute_us'] / 1e3:>12.3f}"
+                f"{pl['comm_us'] / 1e3:>10.3f}"
+                f"{pl['host_gap_us'] / 1e3:>10.3f}")
     if report.get("per_tenant"):
         lines.append(f"  {'tenant':<18}{'count':>6}{'compute_ms':>12}"
                      f"{'comm_ms':>10}{'host_ms':>10}")

@@ -228,6 +228,7 @@ def register_context_gauges(ctx) -> Callable[[], None]:
     gauge(sde.COMPILE_CACHE_BYTES, cc_val("bytes"))
     gauge(sde.COMPILE_BCAST_SENT, cc_val("bcast_sent"))
     gauge(sde.COMPILE_BCAST_RECV, cc_val("bcast_recv"))
+    gauge(sde.COMPILE_LOCAL_ONLY, cc_val("local_only"))
 
     # collective-endpoint counters (comm/coll.py): ops/bytes/segments —
     # zero until the first collective builds the manager
@@ -376,6 +377,8 @@ def prometheus_text(ctx) -> str:
               cc.get("bcast_sent", 0))
         _line(out, "parsec_compile_bcast_recv_total", r,
               cc.get("bcast_recv", 0))
+        _line(out, "parsec_compile_local_only_total", r,
+              cc.get("local_only", 0))
 
     co = doc.get("coll")
     if co is not None:

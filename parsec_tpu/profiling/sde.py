@@ -44,6 +44,7 @@ COMPILE_CACHE_MISSES = "PARSEC::COMPILE::CACHE_MISSES"
 COMPILE_CACHE_BYTES = "PARSEC::COMPILE::CACHE_BYTES"
 COMPILE_BCAST_SENT = "PARSEC::COMPILE::BCAST_SENT"
 COMPILE_BCAST_RECV = "PARSEC::COMPILE::BCAST_RECV"
+COMPILE_LOCAL_ONLY = "PARSEC::COMPILE::LOCAL_ONLY"
 # runtime-collective counters (comm/coll.py CollManager.summary —
 # allreduce / reduce-scatter / allgather / bcast / redistribution rounds)
 COLL_OPS_STARTED = "PARSEC::COLL::OPS_STARTED"

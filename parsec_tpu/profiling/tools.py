@@ -603,6 +603,20 @@ def cmd_autotune(args) -> int:
     cands = None
     if args.nb:
         cands = [int(x) for x in args.nb.split(",")]
+    if args.attention:
+        docs = tuning.autotune_attention(
+            args.n, dtype=args.dtype, candidates=cands, reps=args.reps)
+        for param, doc in docs.items():
+            print(f"attention S={args.n} {doc['dtype']} on "
+                  f"{doc['device_kind']}: best {param}={doc['best']}")
+            for k, v in sorted(doc["timings_s"].items(),
+                               key=lambda kv: kv[1]):
+                print(f"  {param}={k:>5}  {v:.3f}s")
+            for k, why in doc.get("failures", {}).items():
+                print(f"  {param}={k:>5}  FAILED: {why}")
+        print('persisted; the attention graphs pick the winners up via '
+              'q_block="auto" / kv_block="auto"')
+        return 0
     if args.wave:
         doc = tuning.autotune_wave(
             n=args.n, nb=(cands[0] if cands else 64),
@@ -746,6 +760,10 @@ def main(argv=None) -> int:
     pa.add_argument("--wave", action="store_true",
                     help="search the device wave-batch minimum instead "
                     "of nb")
+    pa.add_argument("--attention", action="store_true",
+                    help="search the attention graphs' q_block/kv_block "
+                    "at sequence length --n instead of a dense-op nb "
+                    "(--nb supplies block candidates)")
     pa.set_defaults(fn=cmd_autotune)
     args = p.parse_args(argv)
     return args.fn(args)

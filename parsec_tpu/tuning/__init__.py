@@ -173,12 +173,15 @@ def default_store() -> TuningStore:
 def resolve_nb(op: str, n: int, dtype="float32", *, device=None,
                default: Optional[int] = None,
                divides: Optional[int] = None,
+               param: str = "nb",
                store: Optional[TuningStore] = None) -> Optional[int]:
     """Tuned nb for (op, n, dtype, device generation), or ``default``.
     ``divides=N`` rejects a winner that does not divide N (segmented
-    drivers require it) — the default then stands."""
+    drivers require it) — the default then stands.  ``param`` selects a
+    non-default tuning axis (the attention graphs read ``q_block`` /
+    ``kv_block`` under op ``attention``)."""
     st = store if store is not None else default_store()
-    doc = st.load(tune_key(op, n, dtype, _device_kind(device)))
+    doc = st.load(tune_key(op, n, dtype, _device_kind(device), param))
     if doc is None:
         return default
     best = doc.get("best")
@@ -363,6 +366,84 @@ def autotune_nb(op: str, n: int, dtype="float32", *,
     finally:
         if close is not None:
             close()
+
+
+def attention_runner(s: int, *, d: int = 64, heads: int = 2,
+                     batch: int = 1, dtype="float32", causal: bool = True,
+                     nb_cores: int = 4, param: str = "q_block",
+                     other_block: Optional[int] = None,
+                     use_device: bool = True) -> Callable[[int], float]:
+    """Build the attention block-size search workload: each call runs one
+    blockwise flash-attention taskpool (``ops.attention``) through the
+    dynamic runtime with the candidate value bound to ``param``
+    (``q_block`` or ``kv_block``); the other block size stays at
+    ``other_block`` (default 128-capped).  QKV built once."""
+    import numpy as np
+
+    from ..core.context import Context
+    from ..ops.attention import run_flash_attention
+
+    if param not in ("q_block", "kv_block"):
+        raise ValueError(f"attention tunes q_block/kv_block, not {param!r}")
+    rng = np.random.default_rng(7)
+    dt = np.dtype(dtype)
+    mk = lambda: rng.standard_normal((batch, s, heads, d)).astype(dt)
+    q, k, v = mk(), mk(), mk()
+    other = other_block if other_block is not None else min(128, s)
+    ctx = Context(nb_cores=nb_cores)
+
+    def run(block: int) -> float:
+        if block <= 0 or block > s:
+            raise ValueError(f"{param}={block} outside (0, {s}]")
+        kw = {param: block,
+              ("kv_block" if param == "q_block" else "q_block"): other}
+        t0 = time.perf_counter()
+        run_flash_attention(ctx, q, k, v, causal=causal,
+                            use_tpu=use_device, use_cpu=not use_device,
+                            **kw)
+        return time.perf_counter() - t0
+
+    run.close = ctx.fini  # type: ignore[attr-defined]
+    return run
+
+
+def _default_block_candidates(s: int) -> List[int]:
+    return [b for b in (64, 128, 256, 512) if b <= s] or [s]
+
+
+def autotune_attention(s: int, *, d: int = 64, heads: int = 2,
+                       batch: int = 1, dtype="float32",
+                       causal: bool = True,
+                       candidates: Optional[Iterable[int]] = None,
+                       reps: int = 2,
+                       store: Optional[TuningStore] = None
+                       ) -> Dict[str, Dict[str, Any]]:
+    """Search ``q_block`` and ``kv_block`` for the attention graphs at
+    sequence length ``s`` (two sequential single-axis sweeps; each
+    winner persists under op ``attention`` with its own ``param`` — the
+    EXACT keys ``q_block="auto"``/``kv_block="auto"`` read in
+    :mod:`parsec_tpu.ops.attention`).  Returns ``{param: doc}``."""
+    cands = list(candidates) if candidates else _default_block_candidates(s)
+    docs: Dict[str, Dict[str, Any]] = {}
+    for param in ("q_block", "kv_block"):
+        # the kv sweep runs against the q_block WINNER, not the default,
+        # so the persisted (q_block, kv_block) pair was actually timed
+        # together (in that order; a full cross product is the caller's
+        # candidates= job)
+        other = docs["q_block"]["best"] if docs.get("q_block") else None
+        runner = attention_runner(s, d=d, heads=heads, batch=batch,
+                                  dtype=dtype, causal=causal, param=param,
+                                  other_block=other)
+        try:
+            docs[param] = autotune("attention", s, dtype, param=param,
+                                   candidates=cands, runner=runner,
+                                   reps=reps, store=store,
+                                   meta={"d": d, "heads": heads,
+                                         "batch": batch,
+                                         "causal": causal})
+        finally:
+            runner.close()
+    return docs
 
 
 def autotune_wave(n: int = 1024, nb: int = 64, dtype="float32", *,

@@ -388,3 +388,53 @@ def test_tools_cache_cli(tmp_path, capsys):
     c2.jit(_body, key=("body", "cli2"))(jnp.ones((8, 8), jnp.float32))
     assert tools_main(["cache", "purge", "--dir", str(root)]) == 0
     assert store.count() == 0
+
+
+# ---------------------------------------------------------------------------
+# graceful process-local path (export failures: Pallas custom calls,
+# host callbacks) — counted and surfaced, never silent (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+def _callback_body(x):
+    # host callbacks cannot serialize through jax.export — the canonical
+    # "stays process-local" program shape
+    return jax.pure_callback(
+        lambda a: np.asarray(a) * 2.0,
+        jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+
+def test_unexportable_program_counts_local_only(store, capfd):
+    from parsec_tpu.utils import debug
+
+    debug.set_verbose(2)  # the quiet-test default swallows warnings
+    c = cc.ExecutableCache(store=store, min_disk_s=0.0)
+    f = c.jit(_callback_body, key=("body", "cb"))
+    x = jnp.ones((4,), jnp.float32)
+    np.testing.assert_allclose(np.asarray(f(x)), 2.0)
+    assert c.stats["local_only"] == 1
+    assert c.stats["serialize_errors"] == 1
+    assert store.count() == 0  # nothing shareable was written
+    # the one-time log names the program; a second SHAPE of the same
+    # program counts again but does not re-log
+    err = capfd.readouterr().err
+    assert err.count("not exportable") == 1 and "'cb'" in err
+    np.testing.assert_allclose(np.asarray(f(jnp.ones((8,),
+                                                     jnp.float32))), 2.0)
+    assert c.stats["local_only"] == 2
+    assert "not exportable" not in capfd.readouterr().err
+    # per-process LRU still serves it: repeat dispatches compile nothing
+    misses = c.stats["misses"]
+    hits = c.hits
+    f2 = c.jit(_callback_body, key=("body", "cb"))
+    np.testing.assert_allclose(np.asarray(f2(x)), 2.0)
+    assert c.stats["misses"] == misses and c.hits > hits
+
+
+def test_local_only_snapshot_reaches_health_plane(store):
+    """snapshot() carries local_only, so /metrics
+    (parsec_compile_local_only_total) and the
+    PARSEC::COMPILE::LOCAL_ONLY gauge surface it."""
+    c = cc.ExecutableCache(store=store, min_disk_s=0.0)
+    c.jit(_callback_body, key=("body", "cb2"))(jnp.ones((4,),
+                                                        jnp.float32))
+    assert c.snapshot().get("local_only") == 1

@@ -211,3 +211,26 @@ def test_tools_critpath_cli(tmp_path, capsys):
     assert main(["critpath", p, "--json"]) == 0
     rep = json.loads(capsys.readouterr().out)
     assert rep["buckets"]["compute_us"] == pytest.approx(300.0)
+
+
+def test_critpath_per_label_rollup():
+    """Workload labels: attention-prefixed classes roll up under ONE
+    `attention` row next to per_class (critpath.label_of)."""
+    evs = []
+    evs += _span("exec", 0, 0, 100, tok=1)
+    evs += _span("exec", 0, 120, 200, tok=2)
+    evs += _span("exec", 0, 220, 260, tok=3)
+    evs += [_edge(0, 1, 2), _edge(0, 2, 3)]
+    evs += [_cls(0, 1, "attn_step"), _cls(0, 2, "attn_rstep"),
+            _cls(0, 3, "potrf")]
+    rep = critpath.analyze(evs)
+    assert critpath.label_of("attn_step") == "attention"
+    assert critpath.label_of("attn_out") == "attention"
+    assert critpath.label_of("potrf") is None
+    lab = rep["per_label"]["attention"]
+    assert lab["count"] == 2
+    assert lab["compute_us"] == pytest.approx(180.0)
+    assert set(rep["per_label"]) == {"attention"}  # potrf has no label
+    assert "attention" in critpath.render(rep)
+    # empty report carries the section too
+    assert critpath.analyze([])["per_label"] == {}

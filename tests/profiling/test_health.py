@@ -99,6 +99,7 @@ def test_metrics_scrape_live_2rank_mesh(clean_sde):
             assert "parsec_device_wave_occupancy" in text
             assert f'parsec_compile_cache_hits_total{{rank="{r}"}}' in text
             assert "parsec_compile_bcast_sent_total" in text
+            assert f'parsec_compile_local_only_total{{rank="{r}"}}' in text
             assert 'counter="PARSEC::' in text  # SDE registry exported
 
             st = json.loads(_get(hs.url + "/status"))

@@ -1,0 +1,207 @@
+"""Blockwise flash attention as a PTG (ops/attention.py, ISSUE 11).
+
+Numerics matrix vs the dense oracle (causal/non-causal, f32/bf16, block
+sizes that do NOT divide the sequence → ragged tail blocks), the decode
+shape (short q at the tail of the KV sequence), dynamic-vs-native
+bit-identity, executable-cache behavior of the Pallas-bodied task class,
+and the ``q_block="auto"`` tuning-store resolution.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from parsec_tpu import Context
+from parsec_tpu.ops.attention import (
+    attention_task_count,
+    build_flash_attention,
+    run_flash_attention,
+    run_flash_attention_native,
+)
+from parsec_tpu.parallel import attention_reference
+
+B, S, H, D = 1, 48, 2, 16
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    c = Context(nb_cores=4)
+    yield c
+    c.fini()
+
+
+def qkv(seed=0, dtype=np.float32, s=S, b=B, h=H, d=D):
+    rng = np.random.default_rng(seed)
+
+    def mk():
+        a = rng.standard_normal((b, s, h, d)).astype(np.float32)
+        if dtype == "bfloat16":
+            return np.asarray(jnp.asarray(a, dtype=jnp.bfloat16))
+        return a.astype(dtype)
+
+    return mk(), mk(), mk()
+
+
+def dense_ref(q, k, v, causal):
+    f32 = lambda a: np.asarray(a, dtype=np.float32)
+    return np.asarray(attention_reference(
+        jnp.asarray(f32(q)), jnp.asarray(f32(k)), jnp.asarray(f32(v)),
+        causal=causal))
+
+
+# -- the numerics matrix ----------------------------------------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("dtype,tol", [(np.float32, 2e-5),
+                                       ("bfloat16", 5e-2)])
+@pytest.mark.parametrize("qb,kvb", [(16, 16),   # dividing blocks
+                                    (20, 28)])  # ragged tails (48 % 20, 48 % 28)
+def test_flash_graph_matches_dense(ctx, causal, dtype, tol, qb, kvb):
+    q, k, v = qkv(1, dtype=dtype)
+    out = run_flash_attention(ctx, q, k, v, causal=causal,
+                              q_block=qb, kv_block=kvb)
+    assert out.dtype == q.dtype
+    ref = dense_ref(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32), ref,
+                               rtol=tol, atol=tol)
+
+
+def test_flash_graph_decode_tail(ctx):
+    """Decode shape: a short q block whose causal positions sit at the
+    END of the KV sequence (q_offset defaults to Sk - Sq) must equal the
+    tail rows of full causal attention."""
+    q, k, v = qkv(2)
+    out = run_flash_attention(ctx, q[:, -8:], k, v, causal=True,
+                              q_block=8, kv_block=16)
+    ref = dense_ref(q, k, v, True)[:, -8:]
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_flash_graph_task_count_and_shape_errors(ctx):
+    q, k, v = qkv(3)
+    tp, _ = build_flash_attention(q, k, v, q_block=16, kv_block=20)
+    g = tp.capture(ranks=[0])
+    assert len(g.nodes) == attention_task_count(B, S, S, H, 16, 20)
+    with pytest.raises(ValueError):
+        build_flash_attention(q, k[:, :, :1], v)
+    # causal with Sq > Sk: the default q_offset goes negative, fully
+    # masking leading query rows (l == 0 -> silent NaNs) — rejected loud
+    with pytest.raises(ValueError, match="q_offset"):
+        build_flash_attention(q, k[:, :24], v[:, :24], causal=True)
+    # the same shape is fine non-causal, or with an explicit offset
+    build_flash_attention(q, k[:, :24], v[:, :24], causal=False,
+                          q_block=16, kv_block=16)
+
+
+def test_flash_graph_causal_horizon_prunes_masked_steps(ctx):
+    """Causal graphs stop each carry chain at its diagonal block:
+    fully-masked steps (a provable no-op on the carry) are never even
+    instantiated — and the pruning is numerics-neutral."""
+    q, k, v = qkv(7)
+    tp, _ = build_flash_attention(q, k, v, causal=True, q_block=16,
+                                  kv_block=16)
+    g = tp.capture(ranks=[0])
+    want = attention_task_count(B, S, S, H, 16, 16, causal=True)
+    full = attention_task_count(B, S, S, H, 16, 16)
+    assert len(g.nodes) == want == 18 and full == 24
+    out = run_flash_attention(ctx, q, k, v, causal=True, q_block=16,
+                              kv_block=16)
+    np.testing.assert_allclose(out, dense_ref(q, k, v, True),
+                               rtol=2e-5, atol=2e-5)
+    # the decode offset pushes every block below the diagonal: nothing
+    # prunes, all NK steps run
+    assert attention_task_count(B, 8, S, H, 8, 16, causal=True) \
+        == attention_task_count(B, 8, S, H, 8, 16)
+
+
+# -- native dispatch (PR 3 path) -------------------------------------------
+
+def test_flash_graph_native_bitwise_matches_dynamic(ctx):
+    """The same graph through the native C++ engine (ASYNC device
+    chores, pz_task_done releases) is BIT-identical to the dynamic
+    path — same kernel, same carry order, same executable cache."""
+    from parsec_tpu import native
+
+    if not native.available():
+        pytest.skip(f"native core unavailable: {native.build_error()}")
+    q, k, v = qkv(4)
+    dyn = run_flash_attention(ctx, q, k, v, causal=True,
+                              q_block=16, kv_block=16, use_cpu=False)
+    nat = run_flash_attention_native(q, k, v, causal=True,
+                                     q_block=16, kv_block=16)
+    np.testing.assert_array_equal(dyn, nat)
+
+
+# -- executable-cache behavior of the Pallas-bodied class -------------------
+
+def test_flash_graph_second_run_compiles_nothing(ctx):
+    """The Pallas step body resolves through the ExecutableCache: a
+    second identical taskpool in the same context is pure LRU hits —
+    misses stay flat while hits grow (the per-process layer works even
+    for programs the exporter cannot share)."""
+    q, k, v = qkv(5)
+    kw = dict(causal=True, q_block=16, kv_block=16)
+    run_flash_attention(ctx, q, k, v, **kw)
+    cc = ctx.compile_cache
+    misses0 = cc.stats["misses"]
+    hits0 = cc.hits
+    out = run_flash_attention(ctx, q, k, v, **kw)
+    assert cc.stats["misses"] == misses0, "second attention run recompiled"
+    assert cc.hits > hits0
+    np.testing.assert_allclose(out, dense_ref(q, k, v, True),
+                               rtol=2e-5, atol=2e-5)
+
+
+# -- q_block="auto" resolves through the tuning store -----------------------
+
+def test_flash_graph_auto_blocks_read_tuning_store(ctx):
+    from parsec_tpu import tuning
+
+    st = tuning.default_store()
+    kind = tuning._device_kind()
+    keys = [tuning.tune_key("attention", S, "float32", kind, p)
+            for p in ("q_block", "kv_block")]
+    try:
+        st.save(keys[0], {"best": 24, "op": "attention", "param": "q_block"})
+        st.save(keys[1], {"best": 12, "op": "attention",
+                          "param": "kv_block"})
+        q, k, v = qkv(6)
+        tp, _ = build_flash_attention(q, k, v, q_block="auto",
+                                      kv_block="auto")
+        # winners applied: NQ = ceil(48/24) = 2, NK = ceil(48/12) = 4
+        assert tp.constants["NQ"] == 2 and tp.constants["NK"] == 4
+        out = run_flash_attention(ctx, q, k, v, causal=False,
+                                  q_block="auto", kv_block="auto")
+        np.testing.assert_allclose(out, dense_ref(q, k, v, False),
+                                   rtol=2e-5, atol=2e-5)
+    finally:
+        import os
+
+        for key in keys:  # do not leak winners into other tests
+            try:
+                os.unlink(st._path(key))
+            except (OSError, AttributeError):
+                pass
+
+
+def test_attention_autotune_persists_winners():
+    """The autotuner searches both block axes and persists under the
+    exact keys ``q_block="auto"``/``kv_block="auto"`` read."""
+    import tempfile
+
+    from parsec_tpu import tuning
+
+    with tempfile.TemporaryDirectory() as td:
+        st = tuning.TuningStore(td)
+        docs = tuning.autotune_attention(
+            32, d=8, heads=1, candidates=[16, 32], reps=1, store=st)
+        assert set(docs) == {"q_block", "kv_block"}
+        kind = tuning._device_kind()
+        for param, doc in docs.items():
+            assert doc["best"] in (16, 32)
+            loaded = st.load(
+                tuning.tune_key("attention", 32, "float32", kind, param))
+            assert loaded is not None and loaded["best"] == doc["best"]
+            assert tuning.resolve_nb("attention", 32, "float32",
+                                     param=param, store=st) == doc["best"]

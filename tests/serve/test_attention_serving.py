@@ -1,0 +1,103 @@
+"""Batched-inference serving with REAL ML-shaped DAGs (ISSUE 11): a
+stream of small decode/prefill flash-attention taskpools submitted
+through the RuntimeService, co-resident with a large prefill — wdrr
+fairness keeps the small jobs flowing, admission control queues a burst
+past the in-flight bound, and every served result stays bit-identical
+to its solo run.
+"""
+
+import numpy as np
+
+from parsec_tpu import Context
+from parsec_tpu.ops.attention import build_flash_attention
+from parsec_tpu.parallel import attention_reference
+from parsec_tpu.serve import RuntimeService
+
+H, D = 2, 8
+
+
+def _qkv(sq, sk, seed):
+    rng = np.random.default_rng(seed)
+    mk = lambda s: rng.standard_normal((1, s, H, D)).astype(np.float32)
+    return mk(sq), mk(sk), mk(sk)
+
+
+def _decode_job(seed):
+    """A decode-shaped attention pool: 4 query rows at the tail of a
+    64-token KV sequence (CPU bodies — the serving fairness path).  The
+    oracle is the matching tail of FULL causal attention (the builder's
+    default q_offset places the short q at the sequence end)."""
+    q_full, k, v = _qkv(64, 64, seed)
+    q = np.ascontiguousarray(q_full[:, -4:])
+    tp, assemble = build_flash_attention(
+        q, k, v, causal=True, q_block=4, kv_block=16,
+        use_tpu=False, use_cpu=True)
+    ref = np.asarray(attention_reference(
+        q_full, k, v, causal=True))[:, -4:]
+    return tp, assemble, ref
+
+
+def _prefill_job(seed, s=96):
+    q, k, v = _qkv(s, s, seed)
+    tp, assemble = build_flash_attention(
+        q, k, v, causal=True, q_block=16, kv_block=16,
+        use_tpu=False, use_cpu=True)
+    return tp, assemble
+
+
+def test_decode_stream_coresident_with_prefill_bit_identical():
+    """K decode jobs stream in while a big prefill runs; with wdrr
+    fairness every job completes and each result equals the dense
+    oracle bitwise-stably (same blocks, same order → same floats as a
+    solo run of the same pool)."""
+    # solo oracle outputs first (fresh pools, identical inputs)
+    solo = []
+    ctx = Context(nb_cores=2)
+    try:
+        for i in range(3):
+            tp, assemble, ref = _decode_job(100 + i)
+            ctx.add_taskpool(tp)
+            assert tp.wait(timeout=120)
+            solo.append(assemble())
+            np.testing.assert_allclose(solo[-1], ref, rtol=2e-5,
+                                       atol=2e-5)
+    finally:
+        ctx.fini()
+
+    with RuntimeService(nb_cores=4) as sv:
+        big_tp, big_assemble = _prefill_job(7)
+        big = sv.submit("batch", big_tp, priority=4)
+        handles = []
+        for i in range(3):
+            tp, assemble, ref = _decode_job(100 + i)
+            handles.append((sv.submit("online", tp), assemble, ref, i))
+        for h, assemble, ref, i in handles:
+            assert h.wait(timeout=300), h.status()
+            out = assemble()
+            np.testing.assert_array_equal(out, solo[i])
+            np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+            assert h.latency_s is not None and h.latency_s >= 0
+        assert big.wait(timeout=600), big.status()
+        big_assemble()
+        doc = sv.status_doc()
+        assert doc["tenants"]["online"]["completed"] == 3
+        assert doc["tenants"]["batch"]["completed"] == 1
+
+
+def test_decode_burst_queues_past_inflight_bound():
+    """Admission control with attention DAGs: a burst of decode pools
+    past serve_max_inflight_pools QUEUES (never rejects) and drains to
+    completion."""
+    with RuntimeService(nb_cores=2) as sv:
+        sv.max_inflight_pools = 2
+        jobs = []
+        for i in range(6):
+            tp, assemble, ref = _decode_job(200 + i)
+            jobs.append((sv.submit("online", tp), assemble, ref))
+        counters = sv.counters()
+        assert counters["rejected"] == 0
+        for h, assemble, ref in jobs:
+            assert h.wait(timeout=300), h.status()
+            np.testing.assert_allclose(assemble(), ref, rtol=2e-5,
+                                       atol=2e-5)
+        assert sv.counters()["done"] == 6
