@@ -576,6 +576,16 @@ def _rest_of_main(N, NB, dtype, backend, on_accel, reps, rtt,
         _leg(fields, "batched_attention_serving",
              lambda: batched_attention_serving_leg(fields))
 
+    # ---- STAGE 3i: supertask fusion A/B (round-12 tentpole) ------------
+    # Granularity coarsening (dsl.fusion): the dispatch-bound dpotrf and
+    # the task-graph flash attention with runtime_fusion off vs on —
+    # fused carry chains/waves dispatch as ONE device chore each.
+    # Floors under PARSEC_TPU_PERF_ASSERTS: fused dpotrf >= 2x tasks/s,
+    # fused attention >= 0.7x of the one-program SPMD loop (was 0.40x).
+    if os.environ.get("BENCH_FUSION", "1") != "0" \
+            and not _over_budget(0.97, "fusion_ab stage"):
+        _leg(fields, "fusion_ab", lambda: fusion_ab_leg(fields))
+
     # ---- STAGE 4: QR / LU through the runtime --------------------------
     if on_accel and os.environ.get("BENCH_QRLU", "1") != "0" \
             and not _over_budget(0.80, "qr/lu stage"):
@@ -712,23 +722,17 @@ def multi_tenant_leg(fields: dict) -> None:
         floor_what="small jobs", big_tasks=_dpotrf_ntasks(N, NB))
 
 
-def attention_leg(fields: dict) -> None:
-    """Attention A/B (ISSUE 11): task-graph flash attention (dynamic
-    runtime, Pallas block kernel through the executable cache) vs the
-    SPMD ``shard_map`` ring loop, plus the 2-rank ring-attention PTG
-    with the per-rank comm/compute overlap metric.  GFLOP/s counts the
-    standard 4*B*H*S^2*D attention flops; tasks/s uses the graph's real
-    task count.  Medians over BENCH_ATTN_REPS (round-6 discipline)."""
+def _attention_problem(seed: int = 9) -> dict:
+    """Shared attention-arm scaffolding for ``attention_leg`` AND
+    ``fusion_ab_leg`` (one definition of the env config, QKV data, the
+    numerics gate, and the SPMD shard_map baseline — a fix to either
+    arm's derivation must reach both legs): returns a dict of the
+    config scalars plus ``gate(out, what)`` and ``spmd_once() -> dt``."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from parsec_tpu import Context, native
-    from parsec_tpu.ops.attention import (
-        attention_task_count,
-        run_flash_attention,
-        run_ring_attention_graph,
-    )
+    from parsec_tpu.ops.attention import attention_task_count
     from parsec_tpu.parallel import (
         attention_reference,
         make_mesh,
@@ -740,16 +744,11 @@ def attention_leg(fields: dict) -> None:
     D = int(os.environ.get("BENCH_ATTN_D", "64"))
     S = int(os.environ.get("BENCH_ATTN_S", "1024"))
     blk = int(os.environ.get("BENCH_ATTN_BLOCK", "128"))
-    reps = max(1, int(os.environ.get("BENCH_ATTN_REPS", "3")))
-    cores = int(os.environ.get("BENCH_CORES", "4"))
     flops = 4.0 * B * H * S * S * D  # nominal full-matrix attention flops
     # causal graphs stop each carry chain at its diagonal block, so the
     # real task count is ~half of NQ*NK — tasks/s uses the real count
     ntasks = attention_task_count(B, S, S, H, blk, blk, causal=True)
-    fields["attention_config"] = {"B": B, "S": S, "H": H, "D": D,
-                                  "block": blk, "ntasks": ntasks}
-
-    rng = np.random.default_rng(9)
+    rng = np.random.default_rng(seed)
     mk = lambda: rng.standard_normal((B, S, H, D)).astype(np.float32)
     q, k, v = mk(), mk(), mk()
     ref = np.asarray(attention_reference(
@@ -769,7 +768,6 @@ def attention_leg(fields: dict) -> None:
         nd -= 1
     mesh = make_mesh((nd, 1), axes=("sp", "unused"),
                      devices=jax.devices()[:nd])
-    fields["attention_spmd_ranks"] = nd
     qd, kd, vd = (jax.device_put(jnp.asarray(a)) for a in (q, k, v))
 
     def spmd_once() -> float:
@@ -779,6 +777,37 @@ def attention_leg(fields: dict) -> None:
         dt = time.perf_counter() - t0
         gate(out, "spmd ring_attention")
         return dt
+
+    return dict(B=B, H=H, D=D, S=S, blk=blk, flops=flops,
+                ntasks=ntasks, q=q, k=k, v=v, gate=gate, nd=nd,
+                spmd_once=spmd_once)
+
+
+def attention_leg(fields: dict) -> None:
+    """Attention A/B (ISSUE 11): task-graph flash attention (dynamic
+    runtime, Pallas block kernel through the executable cache) vs the
+    SPMD ``shard_map`` ring loop, plus the 2-rank ring-attention PTG
+    with the per-rank comm/compute overlap metric.  GFLOP/s counts the
+    standard 4*B*H*S^2*D attention flops; tasks/s uses the graph's real
+    task count.  Medians over BENCH_ATTN_REPS (round-6 discipline)."""
+    import numpy as np
+
+    from parsec_tpu import Context, native
+    from parsec_tpu.ops.attention import (
+        run_flash_attention,
+        run_ring_attention_graph,
+    )
+
+    reps = max(1, int(os.environ.get("BENCH_ATTN_REPS", "3")))
+    cores = int(os.environ.get("BENCH_CORES", "4"))
+    prob = _attention_problem()
+    B, S, H, D, blk = (prob[k2] for k2 in ("B", "S", "H", "D", "blk"))
+    flops, ntasks, nd = prob["flops"], prob["ntasks"], prob["nd"]
+    q, k, v, gate, spmd_once = (prob[k2] for k2 in
+                                ("q", "k", "v", "gate", "spmd_once"))
+    fields["attention_config"] = {"B": B, "S": S, "H": H, "D": D,
+                                  "block": blk, "ntasks": ntasks}
+    fields["attention_spmd_ranks"] = nd
 
     spmd_once()  # compile
     for _ in range(reps):
@@ -842,6 +871,205 @@ def attention_leg(fields: dict) -> None:
             assert fields["attention_ring_overlap_mean"] > 0.0, (
                 "attention floor: the ring graph's K/V rotation never "
                 "overlapped compute (per-rank overlap metric == 0)")
+
+
+def fusion_ab_leg(fields: dict) -> None:
+    """Entry point: runs the A/B body, then restores the ambient
+    ``runtime_fusion`` layering (the arms pin the param explicitly in
+    both directions so an exported PARSEC_MCA_runtime_fusion cannot
+    leak into the baseline)."""
+    from parsec_tpu.utils import mca_param
+
+    try:
+        _fusion_ab_leg_body(fields)
+    finally:
+        mca_param.params.unset("runtime", "fusion")
+
+
+def _fusion_ab_leg_body(fields: dict) -> None:
+    """Supertask fusion A/B (round 12, dsl.fusion): the two
+    dispatch-bound trajectory workloads with ``runtime_fusion`` off vs
+    on, same mesh, medians over BENCH_FUSION_REPS.
+
+    * dpotrf DYNAMIC (N=1024 nb=32 by default — CPU-sized tiles, the
+      regime where per-task dispatch dominates): tasks/s + GF/s per
+      arm, ratio quoted; floor fused >= 2x tasks/s under
+      PARSEC_TPU_PERF_ASSERTS.
+    * task-graph flash attention (S=1024): wall per arm, and the
+      attention-vs-SPMD ratio RE-QUOTED with fusion on
+      (``attention_graph_fused_vs_spmd``; the round-11 quote was
+      0.40x) — floor >= 0.7x.  The 2-rank ring graph re-runs fused:
+      its K/V rotation must stay on the wire (per-rank overlap > 0).
+    """
+    import jax
+    import numpy as np
+
+    from parsec_tpu import Context, native
+    from parsec_tpu.datadist import TiledMatrix
+    from parsec_tpu.ops.attention import (
+        run_flash_attention,
+        run_ring_attention_graph,
+    )
+    from parsec_tpu.ops.cholesky import cholesky_ptg
+    from parsec_tpu.utils import mca_param
+
+    reps = max(1, int(os.environ.get("BENCH_FUSION_REPS", "3")))
+    cores = int(os.environ.get("BENCH_CORES", "4"))
+
+    def set_fusion(on: bool) -> None:
+        # explicit BOTH ways: an unset would fall back to an exported
+        # PARSEC_MCA_runtime_fusion env value, silently fusing the
+        # baseline arm and flattening the A/B to ~1.0x (the ambient
+        # layering is restored once, at the end of the leg)
+        mca_param.params.set("runtime", "fusion", "auto" if on else "off")
+
+    # ---- dpotrf dynamic A/B -------------------------------------------
+    N = int(os.environ.get("BENCH_FUSION_N", "1024"))
+    NB = int(os.environ.get("BENCH_FUSION_NB", "32"))
+    ntasks = _dpotrf_ntasks(N, NB)
+    rng = np.random.default_rng(12)
+    M = rng.standard_normal((N, N))
+    SPD = (M @ M.T + N * np.eye(N)).astype(np.float32)
+    L_ref = np.linalg.cholesky(SPD.astype(np.float64))
+    scale = max(1.0, float(np.max(np.abs(L_ref))))
+    flops = N * N * N / 3.0
+    fields["fusion_config"] = {"N": N, "NB": NB, "ntasks": ntasks,
+                               "reps": reps}
+
+    # ONE PTG definition for every rep and both arms — the serving
+    # pattern, and what lets the fusion plan cache amortize capture +
+    # partition + lowering across the per-rep taskpools
+    dpotrf_ptg = cholesky_ptg(use_tpu=True, use_cpu=False)
+
+    def dpotrf_once(ctx) -> float:
+        A = TiledMatrix(N, N, NB, NB, name="A",
+                        dtype=np.float32).from_array(SPD)
+        tp = dpotrf_ptg.taskpool(NT=A.mt, A=A)
+        t0 = time.perf_counter()
+        ctx.add_taskpool(tp)
+        ok = tp.wait(timeout=1800)
+        last = A.data_of(A.mt - 1, A.nt - 1).newest_copy()
+        try:
+            np.asarray(jax.device_get(last.payload)).ravel()[:1]
+        except Exception:
+            pass
+        dt = time.perf_counter() - t0
+        if not ok:
+            raise RuntimeError("fusion_ab dpotrf did not quiesce")
+        Lt = np.asarray(jax.device_get(last.payload))
+        h = Lt.shape[0]
+        err = np.max(np.abs(np.tril(Lt) - np.tril(L_ref[-h:, -h:])))
+        if not np.isfinite(err) or err / scale > 1e-3:
+            raise RuntimeError(f"fusion_ab dpotrf numerics off ({err})")
+        return dt
+
+    for on, key in ((False, "fusion_dpotrf_off"), (True, "fusion_dpotrf_on")):
+        set_fusion(on)
+        try:
+            ctx = Context(nb_cores=cores)
+            try:
+                dpotrf_once(ctx)  # warmup: per-shape + fused compiles
+                for _ in range(reps):
+                    dt = dpotrf_once(ctx)
+                    _record(fields, f"{key}_tasks_per_s", ntasks / dt)
+                    _record(fields, f"{key}_gflops", flops / dt / 1e9)
+                if on:
+                    dev = next((d for d in ctx.devices
+                                if d.mca_name == "tpu"), None)
+                    if dev is not None:
+                        fields["fusion_dpotrf_fused_submits"] = \
+                            int(dev.stats.get("fused_submits", 0))
+                        fields["fusion_dpotrf_fused_tasks"] = \
+                            int(dev.stats.get("fused_tasks", 0))
+            finally:
+                ctx.fini()
+        finally:
+            set_fusion(False)
+    fields["fusion_dpotrf_speedup"] = round(
+        fields["fusion_dpotrf_on_tasks_per_s"]
+        / max(fields["fusion_dpotrf_off_tasks_per_s"], 1e-9), 2)
+
+    # ---- flash attention A/B + SPMD re-quote --------------------------
+    # config, QKV data, numerics gate and the SPMD baseline come from
+    # the SAME scaffolding attention_leg uses (_attention_problem)
+    prob = _attention_problem()
+    blk = prob["blk"]
+    aflops, antasks = prob["flops"], prob["ntasks"]
+    q, k, v, gate, spmd_once = (prob[k2] for k2 in
+                                ("q", "k", "v", "gate", "spmd_once"))
+
+    spmd_once()
+    for _ in range(reps):
+        _record(fields, "fusion_attn_spmd_gflops",
+                aflops / spmd_once() / 1e9)
+
+    for on, key in ((False, "fusion_attn_off"), (True, "fusion_attn_on")):
+        set_fusion(on)
+        try:
+            ctx = Context(nb_cores=cores)
+            try:
+                kw = dict(causal=True, q_block=blk, kv_block=blk)
+
+                def attn_once() -> float:
+                    t0 = time.perf_counter()
+                    out = run_flash_attention(ctx, q, k, v, **kw)
+                    dt = time.perf_counter() - t0
+                    gate(out, "fused flash attention" if on
+                         else "flash attention")
+                    return dt
+
+                attn_once()  # warmup
+                for _ in range(reps):
+                    dt = attn_once()
+                    _record(fields, f"{key}_gflops", aflops / dt / 1e9)
+                    _record(fields, f"{key}_tasks_per_s", antasks / dt)
+            finally:
+                ctx.fini()
+        finally:
+            set_fusion(False)
+    fields["fusion_attn_speedup"] = round(
+        fields["fusion_attn_on_gflops"]
+        / max(fields["fusion_attn_off_gflops"], 1e-9), 2)
+    fields["attention_graph_fused_vs_spmd"] = round(
+        fields["fusion_attn_on_gflops"]
+        / max(fields["fusion_attn_spmd_gflops"], 1e-9), 4)
+
+    # ---- fused ring attention: the rotation must stay on the wire -----
+    set_fusion(True)
+    try:
+        for _ in range(reps):
+            out, stats = run_ring_attention_graph(
+                2, q, k, v, causal=True, nb_cores=max(2, cores // 2),
+                trace_pins=native.available())
+            gate(out, "fused ring attention")
+            if "overlap_fraction" in stats:
+                _record(fields, "fusion_ring_overlap_mean",
+                        stats["overlap_fraction"])
+                _record(fields, "fusion_ring_overlap_min",
+                        stats["overlap_min"])
+    finally:
+        set_fusion(False)
+
+    print(f"fusion_ab: dpotrf {fields['fusion_dpotrf_off_tasks_per_s']}"
+          f" -> {fields['fusion_dpotrf_on_tasks_per_s']} tasks/s "
+          f"({fields['fusion_dpotrf_speedup']}x); attention "
+          f"{fields['fusion_attn_off_gflops']} -> "
+          f"{fields['fusion_attn_on_gflops']} GF/s "
+          f"(vs spmd {fields['attention_graph_fused_vs_spmd']}x, was "
+          "0.40x); ring overlap "
+          f"{fields.get('fusion_ring_overlap_mean')}", file=sys.stderr)
+    if os.environ.get("PARSEC_TPU_PERF_ASSERTS", "1") != "0":
+        assert fields["fusion_dpotrf_speedup"] >= 2.0, (
+            "fusion floor: fused dispatch-bound dpotrf "
+            f"{fields['fusion_dpotrf_speedup']}x < 2x tasks/s")
+        assert fields["attention_graph_fused_vs_spmd"] >= 0.7, (
+            "fusion floor: fused task-graph attention "
+            f"{fields['attention_graph_fused_vs_spmd']}x < 0.7x of the "
+            "one-program SPMD loop")
+        if "fusion_ring_overlap_mean" in fields:
+            assert fields["fusion_ring_overlap_mean"] > 0.0, (
+                "fusion floor: the fused ring graph's K/V rotation "
+                "collapsed into the fused region (overlap == 0)")
 
 
 def batched_attention_serving_leg(fields: dict) -> None:

@@ -15,6 +15,9 @@ from typing import Optional, Tuple
 
 ERROR = "error"
 WARNING = "warning"
+#: advisory findings: surfaced by tools and sweeps, never fatal — not
+#: even under ``--strict`` (the contract of PTG060 fusion hints)
+INFO = "info"
 
 #: stable code -> (severity, one-line description).  Codes are append-only:
 #: tools and user suppressions (``ignore=("PTG021",)``) depend on them.
@@ -52,6 +55,9 @@ CODES = {
                         "instance-level checks were skipped"),
     "PTG051": (ERROR, "graph instantiation failed while evaluating "
                       "dependency expressions"),
+    "PTG060": (INFO, "fusible chain/wave: the supertask partitioner "
+                     "(dsl.fusion) would coarsen these tasks into one "
+                     "dispatch under runtime_fusion; advisory only"),
     # RT0xx: RUNTIME findings (analysis.hb happens-before checker,
     # analysis.lockdep) — unordered pairs of runtime events, not graph
     # defects.  Same append-only contract as PTGxxx.
@@ -190,3 +196,8 @@ def dedup(findings) -> "list[Finding]":
 
 def errors_of(findings):
     return [f for f in findings if f.is_error]
+
+
+def infos_of(findings):
+    """Advisory (info-severity) findings — reported, never fatal."""
+    return [f for f in findings if f.severity == INFO]

@@ -615,8 +615,45 @@ def _hazard_lint(ptg: PTG, g, constants) -> List[Finding]:
     return F
 
 
+def _fusion_hints(ptg: PTG, g, constants) -> List[Finding]:
+    """PTG060 (advisory, info severity): chains/waves the supertask
+    partitioner (:mod:`parsec_tpu.dsl.fusion`) would coarsen into one
+    dispatch each under ``runtime_fusion`` — with the estimated dispatch
+    count saved.  Device-body eligibility is deliberately ignored here
+    (the hint describes the graph's SHAPE; whether the classes carry
+    accelerator bodies is a deployment choice), and the horizon is the
+    fixed :data:`~parsec_tpu.dsl.fusion.DEFAULT_HORIZON` so hints are
+    stable across hosts and tuning stores."""
+    from ..dsl.fusion import DEFAULT_HORIZON, partition
+
+    try:
+        regions = partition(g, ptg.classes, mode="auto",
+                            max_tasks=DEFAULT_HORIZON,
+                            eligible=lambda name: True)
+    except Exception:
+        return []  # advisory only: a partitioner hiccup is not a finding
+    groups: Dict[Tuple, List] = {}
+    for r in regions:
+        classes = []
+        for t in r.members:
+            if t[0] not in classes:
+                classes.append(t[0])
+        groups.setdefault((r.kind, tuple(classes)), []).append(r)
+    F: List[Finding] = []
+    for (kind, classes), rs in sorted(groups.items(), key=repr):
+        ntasks = sum(len(r.members) for r in rs)
+        head = rs[0].members[0]
+        F.append(Finding(
+            "PTG060",
+            f"fusible {kind}(s) of {'+'.join(classes)}: {len(rs)} "
+            f"region(s), {ntasks} tasks -> {len(rs)} dispatches "
+            f"(runtime_fusion would save {ntasks - len(rs)} dispatches)",
+            head[0], None, head[1], dep=f"{kind}:{'+'.join(classes)}"))
+    return F
+
+
 def _instance_lint(ptg: PTG, constants: Dict[str, Any],
-                   max_tasks: int) -> List[Finding]:
+                   max_tasks: int, fusion_hints: bool = False) -> List[Finding]:
     # NOTE the enumeration cost: the cap pre-count, the capture, and the
     # per-node env re-evaluation below each walk the parameter space —
     # correctness-first on an opt-in lint path (the cap MUST precede
@@ -671,6 +708,8 @@ def _instance_lint(ptg: PTG, constants: Dict[str, Any],
                 f"{type(e).__name__}: {e}", tid[0], None, tid[1]))
     if not cycle:
         F.extend(_hazard_lint(ptg, g, constants))
+        if fusion_hints:
+            F.extend(_fusion_hints(ptg, g, constants))
     return F
 
 
@@ -682,14 +721,18 @@ def verify_ptg(ptg: PTG, constants: Optional[Dict[str, Any]] = None, *,
                level: str = "full", known: Iterable[str] = (),
                collections: Optional[Set[str]] = None,
                ignore: Sequence[str] = (),
-               max_tasks: int = DEFAULT_MAX_TASKS) -> List[Finding]:
+               max_tasks: int = DEFAULT_MAX_TASKS,
+               fusion_hints: bool = False) -> List[Finding]:
     """Verify a PTG definition.  ``constants`` are the concrete globals a
     taskpool would be instantiated with (problem sizes + collections);
     with ``constants=None`` (or ``level="static"``) only source-level
     checks run, with ``known``/``collections`` naming the symbols that
     will be supplied later.  ``ignore`` suppresses finding codes.
-    Findings are deduplicated per (code, task, flow, dep) with an
-    instance count; nothing here executes a task body."""
+    ``fusion_hints`` adds the advisory PTG060 findings (info severity,
+    never strict-fatal): chains/waves the supertask partitioner would
+    fuse, with the dispatch count saved.  Findings are deduplicated per
+    (code, task, flow, dep) with an instance count; nothing here
+    executes a task body."""
     if level not in ("static", "full"):
         raise ValueError(f"verify_ptg: unknown level {level!r} "
                          "(expected 'static' or 'full')")
@@ -709,7 +752,8 @@ def verify_ptg(ptg: PTG, constants: Optional[Dict[str, Any]] = None, *,
             and not errors_of(findings):
         # instance checks evaluate the very expressions static errors
         # indict — running them anyway would only add PTG051 noise
-        findings.extend(f for f in _instance_lint(ptg, constants, max_tasks)
+        findings.extend(f for f in _instance_lint(ptg, constants, max_tasks,
+                                                  fusion_hints=fusion_hints)
                         if f.code not in ignored)
     return dedup(findings)
 

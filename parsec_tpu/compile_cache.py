@@ -838,6 +838,11 @@ class ExecutableCache:
         # re-tracing something that lowers in under min_share_s is
         # noise.
         self.stats["misses"] += 1
+        if isinstance(cf.key, tuple) and cf.key and cf.key[0] == "fused":
+            # fused supertask programs (dsl.fusion): counted so the
+            # zero-recompile-on-warm acceptance can pin them apart from
+            # ordinary per-body programs
+            self.stats["fused_compiles"] += 1
         import jax
 
         jitted = jax.jit(cf.fn, donate_argnums=cf.donate)
@@ -875,8 +880,15 @@ class ExecutableCache:
                                   "program %r not serializable (%s: %s); "
                                   "staying process-local", kshort,
                                   type(e).__name__, e)
+            # fused supertask programs ALWAYS share: they are the exact
+            # compile-once artifacts granularity coarsening exists to
+            # amortize (an N-body region re-traces N bodies per process
+            # otherwise), so the tiny-program threshold does not apply
+            fused = isinstance(cf.key, tuple) and cf.key \
+                and cf.key[0] == "fused"
             if blob is not None \
-                    and time.perf_counter() - t0 >= self.min_disk_s:
+                    and (fused
+                         or time.perf_counter() - t0 >= self.min_disk_s):
                 exe = self._share_blob(cf, fp, args, blob, callconv, t0)
                 if exe is not None:
                     return exe, "miss"

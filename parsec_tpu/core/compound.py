@@ -42,6 +42,17 @@ class CompoundTaskpool(Taskpool):
             return
         member = self.members[self._next]
         self._next += 1
+        # serving-plane identity propagates to members at launch: the
+        # compound may have been submitted through a RuntimeService
+        # (tenant + composed priority base set at admission) AFTER
+        # construction, so member tasks inherit the tenant's fairness
+        # weight / job priority and the per-tenant observability slices
+        # (scheduler bins, trace tenant tags, progress()) see them
+        if self.tenant is not None:
+            member.tenant = self.tenant
+            member.tenant_weight = self.tenant_weight
+            member.job_priority = self.job_priority
+            member.priority_base = self.priority_base
         prev_cb = member.on_complete
 
         def chain(tp, _prev=prev_cb):

@@ -140,6 +140,7 @@ class Task:
         "on_complete",
         "prof",
         "user",
+        "fused_n",
         "_tpu_completed",
         "_tpu_attempts",
         "_tpu_effects",
@@ -180,6 +181,10 @@ class Task:
         self.on_complete: Optional[Callable[["Task"], None]] = None
         self.prof: Dict[str, float] = {}
         self.user: Any = None
+        #: member-task count of a fused supertask (dsl.fusion): ONE
+        #: completion retires this many tasks through Taskpool.task_done
+        #: (termdet + nb_retired progress accounting); 1 everywhere else
+        self.fused_n: int = 1
         #: set by the TPU device module once its eager-completion path has
         #: retired the task (guards the manager's error-containment fallback
         #: against double-completion)

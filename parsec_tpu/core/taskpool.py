@@ -142,10 +142,16 @@ class Taskpool:
             self.on_complete(self)
 
     def task_done(self, task: Optional[Task] = None) -> None:
-        """Retire one task (drives termination detection)."""
+        """Retire one task (drives termination detection).  A fused
+        supertask (``task.fused_n > 1``, see :mod:`parsec_tpu.dsl.fusion`)
+        retires ALL its member tasks at this one completion: the members
+        were individually counted into the termdet at startup, so both
+        the countdown and the ``nb_retired`` progress currency (health
+        plane, per-tenant serving accounting) move by N."""
+        n = int(getattr(task, "fused_n", 1) or 1) if task is not None else 1
         with self._retire_lock:
-            self.nb_retired += 1
-        self.tdm.taskpool_addto_nb_tasks(self, -1)
+            self.nb_retired += n
+        self.tdm.taskpool_addto_nb_tasks(self, -n)
 
     def is_done(self) -> bool:
         return self._terminated.is_set()

@@ -499,7 +499,11 @@ class TpuDevice(Device):
         if body is None or getattr(body, "_static_values", False) \
                 or getattr(body, "_donate_args", None) \
                 or getattr(body, "_stage_in", None) \
-                or getattr(body, "_stage_out", None):
+                or getattr(body, "_stage_out", None) \
+                or getattr(body, "_fused_n", 0):
+            # fused supertasks (dsl.fusion) are already coarse-grained
+            # multi-body programs with their own cache key — re-batching
+            # them into waves would nest programs for no dispatch win
             return None
         sig: List[Any] = [getattr(body, "_jit_key", None) or id(body)]
         for kind, payload, mode in (task.body_args or ()):
@@ -730,8 +734,27 @@ class TpuDevice(Device):
             outputs = jitted(*arr_args)
             self._fire_exec(task, pins.EXEC_END)
         else:
+            # fused supertasks carry an explicit content key (member body
+            # fingerprints + region shape, dsl.fusion.FusedPlan.digest):
+            # fingerprinting the program CLOSURE would hash plan
+            # structures instead of member code, so the override is the
+            # cross-process cache identity
+            content_key = getattr(body, "_content_key", None) \
+                or ("body", self._content_fp(body))
+            fused_n = int(getattr(body, "_fused_n", 0) or 0)
+            if fused_n > 1:
+                self.stats["fused_submits"] = \
+                    self.stats.get("fused_submits", 0) + 1
+                self.stats["fused_tasks"] = \
+                    self.stats.get("fused_tasks", 0) + fused_n
+                task.prof["fused_n"] = fused_n
+                from ..profiling import sde
+
+                sde.counter_add(sde.FUSION_REGIONS_DISPATCHED, 1)
+                sde.counter_add(sde.FUSION_TASKS_FUSED, fused_n)
+                sde.counter_add(sde.FUSION_DISPATCH_SAVED, fused_n - 1)
             jitted = self._cached_jit(
-                base_key, ("body", self._content_fp(body)),
+                base_key, content_key,
                 body, donate=donate)
             task._tpu_effects = bool(donate)
             self._fire_exec(task, pins.EXEC_BEGIN)

@@ -51,7 +51,8 @@ class PTGDefinitionView:
 
 
 class TaskNode:
-    __slots__ = ("tid", "priority", "rank", "in_edges", "out_edges", "flow_sources", "write_backs")
+    __slots__ = ("tid", "priority", "rank", "in_edges", "out_edges",
+                 "flow_sources", "write_backs", "remote_out")
 
     def __init__(self, tid: TaskId, priority: int, rank: int):
         self.tid = tid
@@ -66,6 +67,12 @@ class TaskNode:
         self.out_edges: List[Tuple[str, TaskId, str]] = []
         #: predecessor count (dependency goal)
         self.in_edges: int = 0
+        #: successor edges leaving a rank-filtered capture (valid tasks
+        #: placed on OTHER ranks).  Invisible in ``out_edges``, but
+        #: load-bearing for consumers reasoning about convexity — the
+        #: fusion partitioner must not bury a mid-chain remote forward
+        #: (ring attention's K/V rotation) inside a fused region
+        self.remote_out: int = 0
 
 
 class TaskGraph:
@@ -233,6 +240,10 @@ def capture(tp: PTGTaskpool, ranks: Optional[Iterable[int]] = None) -> TaskGraph
                     stid = (t.class_name, locs)
                     if stid in g.nodes:
                         node.out_edges.append((f.name, stid, t.flow_name))
+                    elif stid in g.global_ranks:
+                        # valid successor on another rank: count it so
+                        # rank-filtered consumers see the true out-degree
+                        node.remote_out += 1
 
     # pass 3: in-degrees tallied from the captured edges (NOT goal_of: a
     # rank-filtered capture must count only edges whose producer is in the

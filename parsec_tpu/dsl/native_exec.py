@@ -127,7 +127,8 @@ class NativeExecutor:
     """
 
     def __init__(self, tp: PTGTaskpool, *, graph: Optional[TaskGraph] = None,
-                 native_device: bool = False, device=None):
+                 native_device: bool = False, device=None,
+                 fusion: Optional[str] = None):
         from .. import native
 
         if not native.available():
@@ -150,7 +151,36 @@ class NativeExecutor:
         #: dep-edge emitter walks this
         self._trace_objs: Dict[Tuple, Any] = {}
         self._bodies: List[Callable[[], Any]] = []
+        #: supertask fusion (dsl.fusion): regions of the captured graph
+        #: collapsed to ONE native node each — one device dispatch, one
+        #: pz_task_done retiring N member tasks.  ``fusion=None`` reads
+        #: the runtime_fusion MCA param; device dispatch only (the win
+        #: is the per-task device enqueue, which CPU bodies don't pay).
+        self._regions: List[Any] = []
+        self._region_of: Dict[Tuple, Any] = {}
+        if self.native_device:
+            self._partition_regions(fusion)
         self._build()
+
+    def _partition_regions(self, fusion: Optional[str]) -> None:
+        from ..utils import debug
+        from .fusion import fusion_mode, fusion_max_tasks, partition
+
+        mode = fusion if fusion is not None else fusion_mode()
+        if mode in ("", "off"):
+            return
+        try:
+            self._regions = partition(
+                self.graph, self.taskpool.ptg.classes, mode=mode,
+                max_tasks=fusion_max_tasks(device=self.device))
+            for r in self._regions:
+                for m in r.members:
+                    self._region_of[m] = r
+        except Exception as e:
+            debug.warning("native fusion disabled (%s: %s)",
+                          type(e).__name__, e)
+            self._regions = []
+            self._region_of = {}
 
     @staticmethod
     def _make_device():
@@ -203,7 +233,22 @@ class NativeExecutor:
         index = self._index = {}
 
         order = list(g.nodes)
+        region_native: Dict[int, int] = {}
         for tid in order:
+            reg = self._region_of.get(tid)
+            if reg is not None:
+                # fused region: ONE native node for all members — one
+                # device dispatch, one pz_task_done (dsl.fusion)
+                rid = region_native.get(reg.index)
+                if rid is None:
+                    rid = ng.add_task(
+                        priority=max(g.nodes[m].priority
+                                     for m in reg.members),
+                        user_tag=len(self._bodies))
+                    region_native[reg.index] = rid
+                    self._bodies.append(self._make_fused_dispatch(reg, rid))
+                index[tid] = rid
+                continue
             node = g.nodes[tid]
             index[tid] = ng.add_task(priority=node.priority,
                                      user_tag=len(self._bodies))
@@ -215,17 +260,115 @@ class NativeExecutor:
                 obj = self._trace_objs.get(tid)
                 if isinstance(obj, _NativeDeviceTask):
                     obj.native_id = index[tid]
+        # contracted edges are DEDUPLICATED: add_dep is symmetric (one
+        # in-degree per declared edge, one release per succs entry), so
+        # collapsing parallel region->target edges to one stays balanced
+        # while shaving native succs slots and atomic releases
+        seen_edges = set()
         for tid in order:
             me = index[tid]
             for (_f, succ, _sf) in g.nodes[tid].out_edges:
-                ng.add_dep(me, index[succ])
+                tgt = index[succ]
+                if tgt == me:
+                    continue  # intra-region edge: runs inside the program
+                if self._region_of and (me, tgt) in seen_edges:
+                    continue
+                seen_edges.add((me, tgt))
+                ng.add_dep(me, tgt)
         # commit only after EVERY edge is declared: committing a task arms
         # it, and a task whose in-edges arrive after arming would release
         # early (the commit token covers a task's own declaration window,
         # which for this whole-DAG build is the full edge pass)
+        committed = set()
         for tid in order:
-            ng.commit(index[tid])
+            nid = index[tid]
+            if nid not in committed:
+                committed.add(nid)
+                ng.commit(nid)
         ng.seal()
+
+    def _make_fused_dispatch(self, region, native_id: int) -> Callable[[], Any]:
+        """Enqueue-only trampoline for a FUSED region: one prebuilt
+        supertask whose chore body is the region's jitted program
+        (:class:`..dsl.fusion.FusedPlan`); the completion callback lands
+        every member's cross-tile write-backs and signals ONE
+        ``pz_task_done`` that retires all N members natively."""
+        from ..core.lifecycle import AccessMode
+        from .fusion import FusedPlan
+        from .graph import source_tile
+
+        tp = self.taskpool
+        g = self.graph
+        plan = FusedPlan(tp, g, region)
+
+        def data_of_slot(key):
+            if key[0] == "data":
+                return tp.constants[key[1]].data_of(*key[2])
+            if key[0] == "new":
+                return self._data_for(("new", key[1], key[2]))
+            # ("ext", producer tid, producer flow): the producer's
+            # threaded Data — same resolution its own dispatch would use
+            _, ptid, pflow = key
+            return self._data_for(source_tile(g, ptid, pflow))
+
+        task = _NativeDeviceTask(self._pool_shim,
+                                 self._fused_tclass(plan),
+                                 (region.index,), plan.priority)
+        task.fused_n = len(region.members)
+        chore = Chore(plan.device_type,
+                      hook=lambda es, task: HookReturn.ASYNC)
+        chore.body_fn = plan.body_fn
+        task.selected_chore = chore
+        task.selected_device = self.device
+        task.body_args = [
+            ("data", data_of_slot(k),
+             AccessMode(m) if m else AccessMode.IN)
+            for k, m in zip(plan.slot_keys, plan.slot_modes)]
+        task.native_id = native_id
+
+        # cross-tile write-backs of EVERY member, landed at the one
+        # completion; per home tile only the LAST member's landing
+        # survives (earlier ones would be superseded anyway)
+        wb_map: Dict[Tuple, Tuple] = {}
+        for tid in region.members:
+            for (src_data, cname2, key) in self._write_back_plan(tid):
+                wb_map[(cname2, key)] = (src_data, cname2, key)
+        wbs = list(wb_map.values())
+        ng = self._ng
+
+        def on_complete(t: Task) -> None:
+            if wbs:
+                from ..data.data import land_into_home
+
+                for (src_data, cname2, key) in wbs:
+                    home = self.taskpool.constants[cname2].data_of(*key)
+                    newest = src_data.newest_copy()
+                    land_into_home(home, newest.payload)
+            ng.task_done(t.native_id)
+
+        task.on_complete = on_complete
+        for tid in region.members:
+            self._trace_objs[tid] = task
+        dev = self.device
+        shim = self._pool_shim
+
+        def body():
+            if shim.failed:
+                raise RuntimeError(
+                    f"native device pool failed: {shim.fail_reason}")
+            dev.kernel_scheduler(None, task)
+            return True  # ASYNC: pz_task_done releases the successors
+
+        return body
+
+    def _fused_tclass(self, plan) -> TaskClass:
+        """Bare vtable for a fused supertask (same contract as
+        :meth:`_device_tclass`: every completion-path slot is None)."""
+        cache = self.__dict__.setdefault("_ftclass_cache", {})
+        tc = cache.get(plan.name)
+        if tc is None:
+            tc = cache[plan.name] = TaskClass(plan.name)
+        return tc
 
     def _make_body(self, tid: Tuple) -> Callable[[], Any]:
         """Body dispatcher: numpy in-place (default), device enqueue
@@ -446,9 +589,11 @@ class NativeExecutor:
         for tid, node in self.graph.nodes.items():
             if not node.out_edges:
                 continue
-            succs = [self._trace_objs[s] for (_f, s, _sf) in node.out_edges]
-            pins.fire(pins.RELEASE_DEPS_END, None,
-                      (self._trace_objs[tid], succs))
+            me = self._trace_objs[tid]
+            succs = [self._trace_objs[s] for (_f, s, _sf) in node.out_edges
+                     if self._trace_objs[s] is not me]
+            if succs:
+                pins.fire(pins.RELEASE_DEPS_END, None, (me, succs))
 
     # -- default numpy path ----------------------------------------------
     def _make_numpy_body(self, tid: Tuple) -> Callable[[], None]:
@@ -558,7 +703,10 @@ class NativeExecutor:
         if n != len(bodies):
             raise RuntimeError(
                 f"native engine retired {n}/{len(bodies)} tasks")
-        return n
+        # fused regions collapse N graph tasks into one native node:
+        # report LOGICAL task progress (callers compare against the
+        # taskpool's task count; without fusion the two are equal)
+        return len(self.graph.nodes)
 
     def _apply_vpmap(self, nthreads: int) -> None:
         from ..utils import mca_param

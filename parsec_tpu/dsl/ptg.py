@@ -739,6 +739,11 @@ class PTGTaskpool(Taskpool):
         #: makes the dict get/set safe; a racing double-compute is
         #: idempotent)
         self._exists_memo: Dict[Tuple[str, Tuple], bool] = {}
+        #: supertask-fusion table (dsl.fusion.FusionTable), built at
+        #: attach when ``runtime_fusion`` is on: routes fused members'
+        #: releases to region counters and dispatches each region as ONE
+        #: device chore; None = per-task dispatch (the default)
+        self._fusion = None
         for pc in ptg.classes.values():
             self.repos[pc.name] = DataRepo(nb_flows=len(pc.flows))
             self._build_class(pc)
@@ -806,6 +811,7 @@ class PTGTaskpool(Taskpool):
 
     def attached(self, context) -> None:
         self._maybe_lint()
+        self._maybe_fuse(context)
         if isinstance(self.deps, DenseDepTracker):
             # dense mode: class boxes must be registered before ANY
             # release (a counter split across the hash fallback and the
@@ -825,6 +831,25 @@ class PTGTaskpool(Taskpool):
             if n_wb:
                 self.tdm.taskpool_addto_runtime_actions(self, n_wb)
         super().attached(context)
+
+    def _maybe_fuse(self, context) -> None:
+        """Attach-time supertask fusion (``runtime_fusion`` MCA): carve
+        the captured local subgraph into convex chain/wave regions and
+        dispatch each as one device chore (see :mod:`..dsl.fusion`).  A
+        partitioner failure disables fusion loudly instead of killing
+        the attach — per-task dispatch is always a correct fallback."""
+        from ..utils import debug
+        from .fusion import build_fusion_table, fusion_mode
+
+        self._fusion = None
+        if fusion_mode() in ("", "off"):
+            return
+        try:
+            self._fusion = build_fusion_table(self, context)
+        except Exception as e:
+            debug.warning("taskpool %s: fusion disabled (%s: %s)",
+                          self.ptg.name, type(e).__name__, e)
+            self._fusion = None
 
     def _maybe_lint(self) -> None:
         """Opt-in startup verification (``PARSEC_TPU_LINT``): ``1``/``warn``
@@ -924,7 +949,9 @@ class PTGTaskpool(Taskpool):
                     elif self._claim_source(pc.name, loc):
                         # same exactly-once claim as the chunked branch: with
                         # dynamic guards a producer release can race this scan
-                        out.append(self._make_task(pc, loc))
+                        t = self._route_source(pc, loc)
+                        if t is not None:
+                            out.append(t)
                     else:
                         claimed += 1  # a producer beat the scan to it: fine
                 self._warn_undefined(pc, undefined, claimed)
@@ -960,7 +987,9 @@ class PTGTaskpool(Taskpool):
                     if not self._is_startup(pc, loc, goal_known_zero=True):
                         undefined += 1
                     elif self._claim_source(pc.name, loc):
-                        ready.append(self._make_task(pc, loc))
+                        t = self._route_source(pc, loc)
+                        if t is not None:
+                            ready.append(t)
                     else:
                         claimed += 1  # a producer beat the scan to it: fine
                 if pending >= self.STARTUP_CHUNK:
@@ -978,6 +1007,16 @@ class PTGTaskpool(Taskpool):
             self._local_cache[pc.name] = cached
             self._warn_undefined(pc, undefined, claimed)
         return []
+
+    def _route_source(self, pc: PTGTaskClass, loc: Tuple):
+        """Claimed startup source → a schedulable task: the task itself
+        normally; for a fused member, one region-readiness event (the
+        supertask, exactly once, when the region's last event lands)."""
+        if self._fusion is not None:
+            handled, supertask = self._fusion.route_ready(pc.name, loc)
+            if handled:
+                return supertask
+        return self._make_task(pc, loc)
 
     def _claim_source(self, name: str, locs: Tuple) -> bool:
         """Atomically claim the right to schedule a goal-0 task.  Closes
@@ -1076,10 +1115,10 @@ class PTGTaskpool(Taskpool):
     def _resolve_input(self, pc: PTGTaskClass, f: _PTGFlow, target, env, task: Task) -> Optional[Data]:
         if target is None or isinstance(target, _NoneRef):
             if f.mode & AccessMode.OUT:
-                return self._new_tile(pc, f, task)  # pure output, no source
+                return self._new_tile(pc, f, task.locals)  # pure output, no source
             return None
         if isinstance(target, _NewRef):
-            return self._new_tile(pc, f, task)
+            return self._new_tile(pc, f, task.locals)
         if isinstance(target, _DataRef):
             dc = self.constants[target.collection_name]
             return dc.data_of(*target.key(env))
@@ -1094,7 +1133,7 @@ class PTGTaskpool(Taskpool):
             # asymmetric-deps bug
             if not src_pc.instance_exists(key, self.constants, self._exists_memo):
                 if f.mode & AccessMode.OUT:
-                    return self._new_tile(pc, f, task)
+                    return self._new_tile(pc, f, task.locals)
                 return None
             raise RuntimeError(
                 f"{task!r}: producer {target.class_name}{key} left no repo "
@@ -1133,8 +1172,8 @@ class PTGTaskpool(Taskpool):
                 break
         return tuple(shape), dtype
 
-    def _new_tile(self, pc: PTGTaskClass, f: _PTGFlow, task: Task) -> Data:
-        key = (pc.name, task.locals, f.name)
+    def _new_tile(self, pc: PTGTaskClass, f: _PTGFlow, locals_: Tuple) -> Data:
+        key = (pc.name, tuple(locals_), f.name)
         with self._new_lock:
             d = self._new_tiles.get(key)
             if d is None:
@@ -1146,92 +1185,137 @@ class PTGTaskpool(Taskpool):
     # -- completion / successor release ----------------------------------
     def _make_release_deps(self, pc: PTGTaskClass):
         def release_deps(es, task: Task) -> List[Task]:
-            env = pc.env_of(task.locals, self.constants)
-            repo = self.repos[pc.name]
-            entry = None
-            nb_consumers = 0
-            myrank = self.context.rank if self.context else 0
-            succ_list: List[Tuple[PTGTaskClass, Tuple]] = []
-            # per-destination-rank output masks + one payload per flow:
-            # ONE aggregated activation per rank, however many successors
-            # live there (reference parsec_remote_deps_t, remote_dep.h:132)
-            rank_masks: Dict[int, int] = {}
-            flow_payloads: Dict[int, np.ndarray] = {}
-            for f in pc.flows:
-                data = None
-                if f.mode != CTL and task.body_args is not None:
-                    data = task.body_args[f.index][1]
-                for dep in f.deps_out:
-                    t = dep.target(env)
-                    if t is None or isinstance(t, (_NoneRef, _NewRef)):
-                        continue
-                    if isinstance(t, _DataRef):
-                        if f.mode != CTL:
-                            # CTL flows carry no data: never written back,
-                            # and _count_expected_writebacks skips them too
-                            # (count and send conditions must be identical
-                            # or the owner's termdet never quiesces)
-                            self._write_back(t, env, data)
-                        continue
-                    succ_pc = self.ptg.classes[t.class_name]
-                    for locs in _expand_args(t.args, env):
-                        if len(locs) != len(succ_pc.param_names):
-                            continue
-                        if not succ_pc.valid(locs, self.constants):
-                            continue
-                        rank = succ_pc.rank_of(locs, self.constants)
-                        if rank != myrank:
-                            rank_masks[rank] = rank_masks.get(rank, 0) | (1 << f.index)
-                            if (f.mode != CTL and data is not None
-                                    and f.index not in flow_payloads):
-                                src = data.newest_copy()
-                                if src is not None:
-                                    # raw (possibly device-resident):
-                                    # converted for the transport below
-                                    flow_payloads[f.index] = src.payload
-                            continue
-                        if f.mode != CTL:
-                            if entry is None:
-                                entry = repo.lookup_and_create(task.locals)
-                            entry.copies[f.index] = data
-                            nb_consumers += 1
-                        succ_list.append((succ_pc, locs))
-            if entry is not None:
-                repo.set_usage_limit(task.locals, nb_consumers)
-            # remote successors: one aggregated activation per rank, routed
-            # down the broadcast topology (reference
-            # parsec_remote_dep_activate + propagate, SURVEY.md §3.4)
-            if rank_masks:
-                comm = self.context.comm if self.context else None
-                if comm is None:
-                    raise RuntimeError(
-                        f"task {task!r} has remote successors on ranks "
-                        f"{sorted(rank_masks)} but the context has no comm engine")
-                if not getattr(comm, "device_payloads", False):
-                    # serializing transport: overlap the D2H copies of
-                    # every device-resident flow, then convert once each
-                    # (device-capable fabrics ship jax.Arrays untouched —
-                    # the receiver lands them device-to-device)
-                    from ..comm.payload import prefetch_to_host, to_wire
-
-                    prefetch_to_host(flow_payloads.values())
-                    flow_payloads = {k: to_wire(v)
-                                     for k, v in flow_payloads.items()}
-                comm.remote_dep.send_activations(
-                    self, pc.name, task.locals, rank_masks, flow_payloads,
-                    priority=task.priority)
-            ready: List[Task] = []
-            for succ_pc, locs in succ_list:
-                goal = succ_pc.goal_of(locs, self.constants, self._exists_memo)
-                became, _ = self.deps.release_counter((succ_pc.name, locs), goal)
-                if became and (goal != 0
-                               or self._claim_source(succ_pc.name, locs)):
-                    # goal-0 successors (dynamic guards) race the chunked
-                    # startup scan: the claim keeps execution exactly-once
-                    ready.append(self._make_task(succ_pc, locs))
-            return ready
+            flow_data: List[Optional[Data]] = [None] * len(pc.flows)
+            if task.body_args is not None:
+                for f in pc.flows:
+                    if f.mode != CTL:
+                        flow_data[f.index] = task.body_args[f.index][1]
+            return self._release_deps_core(pc, task.locals, flow_data,
+                                           task.priority)
 
         return release_deps
+
+    def _release_deps_core(self, pc: PTGTaskClass, locals_: Tuple,
+                           flow_data: List[Optional[Data]],
+                           priority: int,
+                           origin_region=None) -> List[Task]:
+        """Successor release for one (possibly virtual) completed task:
+        write-backs, repo deposits, remote activations, and dependency-
+        counter decrements.  ``flow_data[f.index]`` is the Data behind
+        each non-CTL flow.  ``origin_region`` (a member-tid set) is the
+        supertask release path: successors INSIDE the producer's own
+        fused region are skipped entirely — they executed inside the
+        fused program, never consume the repo, and must not be released
+        (a decrement would double-schedule the region)."""
+        env = pc.env_of(locals_, self.constants)
+        repo = self.repos[pc.name]
+        fusion = self._fusion
+        entry = None
+        nb_consumers = 0
+        myrank = self.context.rank if self.context else 0
+        succ_list: List[Tuple[PTGTaskClass, Tuple]] = []
+        # per-destination-rank output masks + one payload per flow:
+        # ONE aggregated activation per rank, however many successors
+        # live there (reference parsec_remote_deps_t, remote_dep.h:132)
+        rank_masks: Dict[int, int] = {}
+        flow_payloads: Dict[int, np.ndarray] = {}
+        for f in pc.flows:
+            data = None
+            if f.mode != CTL:
+                data = flow_data[f.index]
+            for dep in f.deps_out:
+                t = dep.target(env)
+                if t is None or isinstance(t, (_NoneRef, _NewRef)):
+                    continue
+                if isinstance(t, _DataRef):
+                    if f.mode != CTL:
+                        # CTL flows carry no data: never written back,
+                        # and _count_expected_writebacks skips them too
+                        # (count and send conditions must be identical
+                        # or the owner's termdet never quiesces)
+                        self._write_back(t, env, data)
+                    continue
+                succ_pc = self.ptg.classes[t.class_name]
+                for locs in _expand_args(t.args, env):
+                    if len(locs) != len(succ_pc.param_names):
+                        continue
+                    if not succ_pc.valid(locs, self.constants):
+                        continue
+                    if origin_region is not None \
+                            and (t.class_name, locs) in origin_region:
+                        continue  # intra-region edge: handled in-program
+                    rank = succ_pc.rank_of(locs, self.constants)
+                    if rank != myrank:
+                        rank_masks[rank] = rank_masks.get(rank, 0) | (1 << f.index)
+                        if (f.mode != CTL and data is not None
+                                and f.index not in flow_payloads):
+                            src = data.newest_copy()
+                            if src is not None:
+                                # raw (possibly device-resident):
+                                # converted for the transport below
+                                flow_payloads[f.index] = src.payload
+                        continue
+                    if f.mode != CTL:
+                        if entry is None:
+                            entry = repo.lookup_and_create(locals_)
+                        entry.copies[f.index] = data
+                        nb_consumers += 1
+                    succ_list.append((succ_pc, locs))
+        if entry is not None:
+            repo.set_usage_limit(locals_, nb_consumers)
+        # remote successors: one aggregated activation per rank, routed
+        # down the broadcast topology (reference
+        # parsec_remote_dep_activate + propagate, SURVEY.md §3.4)
+        if rank_masks:
+            comm = self.context.comm if self.context else None
+            if comm is None:
+                raise RuntimeError(
+                    f"task {pc.name}{locals_} has remote successors on "
+                    f"ranks {sorted(rank_masks)} but the context has no "
+                    "comm engine")
+            if not getattr(comm, "device_payloads", False):
+                # serializing transport: overlap the D2H copies of
+                # every device-resident flow, then convert once each
+                # (device-capable fabrics ship jax.Arrays untouched —
+                # the receiver lands them device-to-device)
+                from ..comm.payload import prefetch_to_host, to_wire
+
+                prefetch_to_host(flow_payloads.values())
+                flow_payloads = {k: to_wire(v)
+                                 for k, v in flow_payloads.items()}
+            comm.remote_dep.send_activations(
+                self, pc.name, locals_, rank_masks, flow_payloads,
+                priority=priority)
+        ready: List[Task] = []
+        for succ_pc, locs in succ_list:
+            if fusion is not None:
+                ext = fusion.ext_goal(succ_pc.name, locs)
+                if ext is not None:
+                    # fused member: its counter runs with the EXTERNAL
+                    # goal (intra-region producers never fire), and
+                    # readiness feeds the region, not a per-task
+                    # schedule.  ext-goal-0 members need the same
+                    # exactly-once claim as unfused goal-0 successors:
+                    # a goal-0 counter fires on EVERY release, and a
+                    # duplicate region event would over-decrement the
+                    # waiting count and dispatch the supertask early
+                    became, _ = self.deps.release_counter(
+                        (succ_pc.name, locs), ext)
+                    if became and (ext != 0 or self._claim_source(
+                            succ_pc.name, locs)):
+                        _, supertask = fusion.route_ready(
+                            succ_pc.name, locs)
+                        if supertask is not None:
+                            ready.append(supertask)
+                    continue
+            goal = succ_pc.goal_of(locs, self.constants, self._exists_memo)
+            became, _ = self.deps.release_counter((succ_pc.name, locs), goal)
+            if became and (goal != 0
+                           or self._claim_source(succ_pc.name, locs)):
+                # goal-0 successors (dynamic guards) race the chunked
+                # startup scan: the claim keeps execution exactly-once
+                ready.append(self._make_task(succ_pc, locs))
+        return ready
 
     def _write_back(self, t: _DataRef, env, data: Optional[Data]) -> None:
         dc = self.constants[t.collection_name]
@@ -1360,6 +1444,24 @@ class PTGTaskpool(Taskpool):
                                     (src_class, src_locals, f.index), payload)
                             deposited = True
                         nb_consumers += 1
+                    if self._fusion is not None:
+                        ext = self._fusion.ext_goal(t.class_name, locs)
+                        if ext is not None:
+                            # remote producers are always external to a
+                            # (rank-local) fused region: decrement the
+                            # member's EXTERNAL goal and feed the region
+                            # (ext-goal-0 members carry the same
+                            # exactly-once claim as the local path —
+                            # goal-0 counters fire on every release)
+                            became, _ = self.deps.release_counter(
+                                (t.class_name, locs), ext)
+                            if became and (ext != 0 or self._claim_source(
+                                    t.class_name, locs)):
+                                _, supertask = self._fusion.route_ready(
+                                    t.class_name, locs)
+                                if supertask is not None:
+                                    ready.append(supertask)
+                            continue
                     goal = succ_pc.goal_of(locs, self.constants, self._exists_memo)
                     became, _ = self.deps.release_counter(
                         (t.class_name, locs), goal)

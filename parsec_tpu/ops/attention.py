@@ -44,6 +44,7 @@ The numerics oracle for every path remains
 
 from __future__ import annotations
 
+import collections
 import math
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -443,6 +444,32 @@ def _resolve_block(value, op_param: str, seq: int, dtype) -> int:
                                  param=op_param, default=default) or default)
 
 
+#: memo of flash-attention PTG *definitions* keyed by every builder
+#: argument: a serving mesh (and the fusion plan cache keyed on the
+#: definition object, dsl.fusion) instantiates many same-shaped pools —
+#: a PTG is problem-size-independent and explicitly reusable, so
+#: rebuilding the class/dep structure per request is pure overhead.
+#: BOUNDED LRU: decode serving bakes a growing q_offset (Sk - Sq) into
+#: the key every step, and each retained definition also anchors its
+#: weak-keyed fusion-plan cache entry — an unbounded memo would leak
+#: one immortal definition per decode step
+_PTG_MEMO: "collections.OrderedDict[Tuple, PTG]" = collections.OrderedDict()
+_PTG_MEMO_MAX = 32
+_PTG_MEMO_LOCK = threading.Lock()
+
+
+def _flash_ptg_cached(**kw) -> PTG:
+    key = tuple(sorted(kw.items()))
+    with _PTG_MEMO_LOCK:
+        p = _PTG_MEMO.get(key)
+        if p is None:
+            p = _PTG_MEMO[key] = flash_attention_ptg(**kw)
+        _PTG_MEMO.move_to_end(key)
+        while len(_PTG_MEMO) > _PTG_MEMO_MAX:
+            _PTG_MEMO.popitem(last=False)
+        return p
+
+
 def _carry_inits(D: int, q_sizes: Sequence[int]):
     """(CA, CM, CL) init callables for the per-query-block carries."""
     def ca(g, i):
@@ -515,7 +542,7 @@ def build_flash_attention(q, k, v, *, causal: bool = False,
     Oc = PlaneCollection(
         "O", lambda g, i: np.zeros((qs[i][1], D), odt), keys=keys_q)
     ca, cm, cl = _carry_inits(D, [n for _, n in qs])
-    tp = flash_attention_ptg(
+    tp = _flash_ptg_cached(
         causal=causal, scale=scale_v, q_block=qb, kv_block=kvb,
         q_offset=q_offset, use_tpu=use_tpu, use_cpu=use_cpu,
         interpret=interpret,

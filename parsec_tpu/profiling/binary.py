@@ -270,6 +270,12 @@ class RankTraceSet:
                 tenant = getattr(task.taskpool, "tenant", None)
                 if tenant:
                     tr.instant(tr.keyword(f"tenant:{tenant}"), t)
+                # fused supertask (dsl.fusion): record the member count
+                # (info = N) so critpath can report the dispatches saved;
+                # member CLASSES ride the fused[...]  class name above
+                fused_n = int(getattr(task, "fused_n", 1) or 1)
+                if fused_n > 1:
+                    tr.instant(tr.keyword("fused_n"), t, fused_n)
         return t
 
     # -- lifecycle -------------------------------------------------------

@@ -60,7 +60,14 @@ PREFIX_LABELS: Tuple[Tuple[str, str], ...] = (("attn_", "attention"),)
 
 
 def label_of(cls: str) -> Optional[str]:
-    """Workload label of a task-class name, or None."""
+    """Workload label of a task-class name, or None.  A fused supertask
+    (``fused[a+b]``, :mod:`parsec_tpu.dsl.fusion`) carries its member
+    classes in the name: it takes the members' common label — a fused
+    attention chain rolls up under ``attention`` exactly like its
+    unfused members would."""
+    if cls.startswith("fused[") and cls.endswith("]"):
+        labs = {label_of(m) for m in cls[6:-1].split("+")}
+        return labs.pop() if len(labs) == 1 else None
     lab = CLASS_LABELS.get(cls)
     if lab is not None:
         return lab
@@ -117,6 +124,9 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
     exec_open: Dict[Tuple[Any, Any], float] = {}
     tasks: Dict[Tuple[Any, int], dict] = {}
     classes: Dict[Tuple[Any, int], str] = {}
+    #: fused supertasks: token -> member count (``fused_n`` instants,
+    #: profiling.binary) — the dispatch-amortization evidence
+    fused: Dict[Tuple[Any, int], int] = {}
     #: serving-plane attribution: ``tenant:<name>`` instants map tokens
     #: to the tenant whose job the task belonged to (profiling.binary)
     tenants: Dict[Tuple[Any, int], str] = {}
@@ -167,6 +177,10 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
             src, dst = args.get("event_id"), args.get("info")
             if src is not None and dst is not None:
                 preds[(pid, dst)].append((pid, src))
+        elif name == "fused_n" and ph == "i":
+            n = int(args.get("info", 0) or 0)
+            if n > 1:
+                fused[(pid, args.get("event_id"))] = n
         elif isinstance(name, str) and name.startswith("class:") and ph == "i":
             classes[(pid, args.get("event_id"))] = name[6:]
         elif isinstance(name, str) and name.startswith("tenant:") and ph == "i":
@@ -196,12 +210,21 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
                 if b is not None:
                     coll_iv[pid].append((b, e["ts"]))
 
+    # fusion summary over the WHOLE trace (not just the chain): every
+    # fused dispatch is one device enqueue standing in for N member
+    # tasks — "dispatch saved" is the amortization the fusion pass buys
+    fused_summary = {
+        "regions": len(fused),
+        "tasks": int(sum(fused.values())),
+        "dispatch_saved": int(sum(fused.values()) - len(fused)),
+    }
     empty = {"wall_us": 0.0, "n_tasks": 0, "coverage": 0.0,
              "buckets": {"compute_us": 0.0, "comm_us": 0.0,
                          "coll_us": 0.0, "compile_us": 0.0,
                          "host_gap_us": 0.0},
              "per_class": {}, "per_label": {}, "per_tenant": {},
-             "chain": [], "comm_regimes": regimes}
+             "chain": [], "comm_regimes": regimes,
+             "fused": fused_summary}
     if not tasks:
         return empty
     comm_merged = {pid: _merge_intervals(iv) for pid, iv in comm_iv.items()}
@@ -306,6 +329,7 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
         "per_tenant": {k: dict(v) for k, v in per_tenant.items()},
         "chain": rows,
         "comm_regimes": regimes,
+        "fused": fused_summary,
     }
 
 
@@ -323,6 +347,12 @@ def render(report: dict) -> str:
         frac = b.get(k, 0.0) / wall if wall > 0 else 0.0
         lines.append(f"  {k[:-3]:<10} {b.get(k, 0.0) / 1e3:>10.3f} ms"
                      f"  {frac:>6.1%}")
+    fu = report.get("fused")
+    if fu and fu.get("regions"):
+        lines.append(
+            f"  fused dispatch saved: {fu['dispatch_saved']} "
+            f"({fu['regions']} fused regions covering {fu['tasks']} "
+            "member tasks)")
     reg = report.get("comm_regimes")
     if reg and (reg["eager"]["events"] or reg["rdv"]["events"]):
         ev_e, ev_r = reg["eager"]["events"], reg["rdv"].get("transfers", 0)

@@ -243,6 +243,23 @@ def register_context_gauges(ctx) -> Callable[[], None]:
     gauge(sde.COLL_BYTES, coll_val("bytes"))
     gauge(sde.COLL_SEGMENTS_INFLIGHT, coll_val("segments_inflight"))
 
+    # supertask-fusion device counters (dsl.fusion; accumulated by the
+    # device layer at fused dispatch): zero with runtime_fusion=off —
+    # registered unconditionally so the doc'd gauge set is always live
+    def fusion_val(key: str):
+        def get() -> float:
+            return float(sum(int(d.stats.get(key, 0))
+                             for d in ctx.devices))
+        return get
+
+    gauge(sde.FUSION_REGIONS_DISPATCHED, fusion_val("fused_submits"))
+    gauge(sde.FUSION_TASKS_FUSED, fusion_val("fused_tasks"))
+    gauge(sde.FUSION_DISPATCH_SAVED,
+          lambda: float(sum(
+              int(d.stats.get("fused_tasks", 0))
+              - int(d.stats.get("fused_submits", 0))
+              for d in ctx.devices)))
+
     # serving-plane counters (serve.RuntimeService on ctx.serve): zero
     # until a service attaches — registered unconditionally so external
     # monitors can alert on them before the first job arrives

@@ -51,6 +51,11 @@ COLL_OPS_STARTED = "PARSEC::COLL::OPS_STARTED"
 COLL_OPS_DONE = "PARSEC::COLL::OPS_DONE"
 COLL_BYTES = "PARSEC::COLL::BYTES"
 COLL_SEGMENTS_INFLIGHT = "PARSEC::COLL::SEGMENTS_INFLIGHT"
+# supertask-fusion counters (dsl.fusion / device dispatch of fused
+# chores — accumulated at fused dispatch, 0 when runtime_fusion=off)
+FUSION_REGIONS_DISPATCHED = "PARSEC::FUSION::REGIONS_DISPATCHED"
+FUSION_TASKS_FUSED = "PARSEC::FUSION::TASKS_FUSED"
+FUSION_DISPATCH_SAVED = "PARSEC::FUSION::DISPATCH_SAVED"
 # serving-plane counters (serve.RuntimeService.status_doc — read 0 when
 # no service is attached to the context)
 SERVE_JOBS_QUEUED = "PARSEC::SERVE::JOBS_QUEUED"

@@ -306,7 +306,8 @@ def _lint_one(target: str, overrides: Dict[str, Any], ignore):
             notes.append(f"missing globals {missing} (pass -D NAME=VALUE): "
                          "static checks only")
             return target, lint_jdf(jdf, ignore=ignore), notes
-        return target, lint_jdf(jdf, consts, ignore=ignore), notes
+        return target, lint_jdf(jdf, consts, ignore=ignore,
+                                fusion_hints=True), notes
     if ":" in target:
         from ..analysis.linter import collection_names, free_symbols
 
@@ -331,19 +332,22 @@ def _lint_one(target: str, overrides: Dict[str, Any], ignore):
                 known=free_symbols(ptg) | set(consts),
                 collections=collection_names(ptg), ignore=ignore)
             return target, findings, notes
-        return target, verify_ptg(ptg, consts, ignore=ignore), notes
+        return target, verify_ptg(ptg, consts, ignore=ignore,
+                                  fusion_hints=True), notes
     from ..analysis import registry
 
     ptg, consts = registry.build(target)
     consts = dict(consts)
     consts.update(overrides)
-    return target, verify_ptg(ptg, consts, ignore=ignore), notes
+    return target, verify_ptg(ptg, consts, ignore=ignore,
+                              fusion_hints=True), notes
 
 
 def cmd_lint(args) -> int:
     """Ahead-of-time graph verifier CLI (see parsec_tpu.analysis)."""
     from ..analysis import errors_of
     from ..analysis import registry
+    from ..analysis.findings import infos_of
 
     ignore = tuple(c for arg in (args.ignore or [])
                    for c in arg.split(",") if c)
@@ -357,7 +361,7 @@ def cmd_lint(args) -> int:
               file=sys.stderr)
         return 2
     overrides = _parse_defines(args.define)
-    n_err = n_warn = 0
+    n_err = n_warn = n_info = 0
     failed = False
     for target in targets:
         try:
@@ -372,14 +376,19 @@ def cmd_lint(args) -> int:
         for f in findings:
             print(f"{name}: {f}")
         errs = len(errors_of(findings))
+        infos = len(infos_of(findings))
         n_err += errs
-        n_warn += len(findings) - errs
-        if not findings:
-            print(f"{name}: OK")
+        n_info += infos
+        n_warn += len(findings) - errs - infos
+        if errs == 0 and errs + infos == len(findings):
+            # advisory-only graphs are still clean
+            print(f"{name}: OK"
+                  + (f" ({infos} advisory)" if infos else ""))
     print(f"lint: {len(targets)} graph(s), {n_err} error(s), "
-          f"{n_warn} warning(s)")
+          f"{n_warn} warning(s), {n_info} advisory")
     if failed or n_err:
         return 1
+    # advisory findings (PTG060 fusion hints) NEVER fail --strict
     if args.strict and n_warn:
         return 1
     return 0

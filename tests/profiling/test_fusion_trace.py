@@ -1,0 +1,106 @@
+"""Fused-span observability (dsl.fusion x profiling): fused chore
+events carry ``fused_n`` + member classes, ``per_label`` attributes a
+fused attention chain to the ``attention`` label, and ``tools
+critpath`` renders the ``fused dispatch saved`` line — golden unit test
+plus a real-trace test on a fused run."""
+
+import json
+
+import numpy as np
+import pytest
+
+from parsec_tpu.profiling import critpath
+from parsec_tpu.utils import mca_param
+
+
+# ---------------------------------------------------------------------------
+# golden unit test: synthetic events
+# ---------------------------------------------------------------------------
+
+def _span(name, tok, b, e, pid=0, tid=0):
+    return [
+        {"name": name, "ph": "B", "ts": b, "pid": pid, "tid": tid,
+         "args": {"event_id": tok}},
+        {"name": name, "ph": "E", "ts": e, "pid": pid, "tid": tid,
+         "args": {"event_id": tok}},
+    ]
+
+
+def _instant(name, tok, info=None, pid=0):
+    args = {"event_id": tok}
+    if info is not None:
+        args["info"] = info
+    return {"name": name, "ph": "i", "ts": 0.0, "pid": pid, "args": args}
+
+
+def test_critpath_fused_golden():
+    ev = []
+    # token 1: a fused attention chain of 8 members; token 2: its
+    # (fused) consumer; token 3: an ordinary task
+    ev += _span("exec", 1, 0.0, 100.0)
+    ev += _span("exec", 2, 120.0, 150.0)
+    ev += _span("exec", 3, 160.0, 170.0)
+    ev.append(_instant("class:fused[attn_step+attn_out]", 1))
+    ev.append(_instant("class:fused[attn_step+attn_out]", 2))
+    ev.append(_instant("class:attn_out", 3))
+    ev.append(_instant("fused_n", 1, 8))
+    ev.append(_instant("fused_n", 2, 4))
+    ev.append(_instant("dep_edge", 1, 2))
+    ev.append(_instant("dep_edge", 2, 3))
+    rep = critpath.analyze(ev)
+    assert rep["n_tasks"] == 3
+    assert rep["fused"] == {"regions": 2, "tasks": 12,
+                            "dispatch_saved": 10}
+    # per_label: the fused class name maps through its MEMBER classes
+    assert "attention" in rep["per_label"]
+    assert rep["per_label"]["attention"]["count"] == 3
+    text = critpath.render(rep)
+    assert "fused dispatch saved: 10" in text
+    assert "2 fused regions" in text
+
+
+def test_label_of_fused_names():
+    assert critpath.label_of("fused[attn_step+attn_out]") == "attention"
+    assert critpath.label_of("attn_step") == "attention"
+    # mixed labels -> no single rollup
+    assert critpath.label_of("fused[attn_step+potrf]") is None
+    assert critpath.label_of("fused[potrf+syrk]") is None
+
+
+# ---------------------------------------------------------------------------
+# real trace: a fused dynamic run through the per-rank tracer
+# ---------------------------------------------------------------------------
+
+def test_fused_run_trace_reports_dispatch_saved(tmp_path):
+    from parsec_tpu import Context, native
+    from parsec_tpu.ops.attention import run_flash_attention
+    from parsec_tpu.profiling.overlap import measure_overlap
+
+    if not native.available():
+        pytest.skip(f"native core unavailable: {native.build_error()}")
+    rng = np.random.default_rng(4)
+    q = rng.standard_normal((1, 128, 2, 8)).astype(np.float32)
+    mca_param.params.set("runtime", "fusion", "auto")
+    stats = {}
+    try:
+        with measure_overlap(stats, trace_dir=str(tmp_path)):
+            ctx = Context(nb_cores=2)
+            try:
+                run_flash_attention(ctx, q, q, q, causal=True,
+                                    q_block=32, kv_block=32,
+                                    use_cpu=False)
+            finally:
+                ctx.fini()
+    finally:
+        mca_param.params.unset("runtime", "fusion")
+    with open(stats["merged_trace"]) as f:
+        doc = json.load(f)
+    rep = critpath.analyze(doc.get("traceEvents", []))
+    fu = rep["fused"]
+    # every (g, i) chain fused: G=2 groups x 4 query blocks
+    assert fu["regions"] > 0
+    assert fu["tasks"] > fu["regions"]
+    assert fu["dispatch_saved"] == fu["tasks"] - fu["regions"]
+    # the fused chain rolls up under the attention label
+    assert rep["per_label"].get("attention", {}).get("count", 0) > 0
+    assert "fused dispatch saved" in critpath.render(rep)

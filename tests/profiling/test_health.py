@@ -180,6 +180,9 @@ def test_sde_doc_drift_after_dpotrf(clean_sde):
     assert {sde.SERVE_JOBS_QUEUED, sde.SERVE_JOBS_INFLIGHT,
             sde.SERVE_JOBS_DONE, sde.SERVE_JOBS_REJECTED,
             sde.SERVE_TENANTS} <= documented
+    # ...and the supertask-fusion gauge set (PR 12)
+    assert {sde.FUSION_REGIONS_DISPATCHED, sde.FUSION_TASKS_FUSED,
+            sde.FUSION_DISPATCH_SAVED} <= documented
 
     n, nb = 64, 16
     rng = np.random.default_rng(5)
