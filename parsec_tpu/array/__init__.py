@@ -1,0 +1,42 @@
+"""parsec_tpu.array — the HDArray-style distributed tiled-array front-end.
+
+Tiled arrays with a distribution (2-D block-cyclic / 1-D / replicated —
+:mod:`.dist`) and a LAZY expression layer (:mod:`.expr`): ``matmul``,
+``cholesky``, triangular ``solve``, elementwise ``add/sub/mul/scale``,
+``transpose``, ``sum``/``norm`` (riding the runtime collectives), and
+``redistribute``.  ``DistArray.compute(ctx)`` (or
+``lower([...]).run(ctx)``) compiles the whole expression graph into ONE
+lint-clean taskpool — cross-op edges are flow dependencies, no
+materialize-and-reload between ops (:mod:`.lower`).  See USERGUIDE §16.
+
+    import numpy as np
+    from parsec_tpu import Context
+    from parsec_tpu import array as pa
+
+    A = pa.from_numpy(G, 32)          # 32x32 tiles
+    B = pa.from_numpy(H, 32)
+    b = pa.from_numpy(rhs, 32, 1)
+    C = (A @ A.T + B).cholesky()      # nothing runs yet
+    x = C.solve(b)
+    with Context(nb_cores=4) as ctx:
+        x.compute(ctx, others=[C])    # ONE taskpool for the whole chain
+    print(x.to_numpy())
+"""
+
+from .dist import Block1D, BlockCyclic, Distribution, Replicated
+from .expr import DistArray, from_numpy, zeros
+from .lower import ArrayProgram, canonical_program, counters, lower
+
+__all__ = [
+    "ArrayProgram",
+    "Block1D",
+    "BlockCyclic",
+    "DistArray",
+    "Distribution",
+    "Replicated",
+    "canonical_program",
+    "counters",
+    "from_numpy",
+    "lower",
+    "zeros",
+]

@@ -1,0 +1,117 @@
+"""Tile bodies for the generated array task classes.
+
+The linear-algebra kernels are the EXISTING :mod:`parsec_tpu.ops.tiles`
+bodies (potrf/trsm/syrk/gemm_update for Cholesky, gemm for matmul,
+trsv_fwd/gemm_sub for the triangular solve) — the array layer generates
+graphs, it does not grow a second kernel library.  What lives here are
+the small glue bodies the expression ops need (elementwise combine,
+transpose, copy/forward, partial reductions), each in the standard two
+incarnations: ``*_cpu`` numpy (may mutate INOUT tiles in place or return
+a replacement) and ``*_tpu`` functional JAX (returns fresh arrays; jit
+compiled through the PR-7 executable cache like every device chore).
+
+Every body is MODULE-LEVEL so the compile cache's content fingerprint
+(bytecode + closure values) is stable across processes — a generated
+array program keys into the same persistent executable entries on every
+rank and every run.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import jax.numpy as jnp
+except Exception:  # pragma: no cover
+    jnp = None
+
+from ..ops import tiles  # noqa: F401  (re-exported kernel source)
+
+
+# -- matmul ------------------------------------------------------------------
+# The k-chain init: C(i,j) = A(i,0) @ B(0,j) overwriting the chain tile
+# (never read at k==0) — accumulation steps reuse tiles.gemm_*.
+
+def mm_init_cpu(a, b, c, **_):
+    c[:] = np.asarray(a) @ np.asarray(b)
+
+
+def mm_init_tpu(a, b, c, **_):
+    return jnp.dot(a, b, precision="highest")
+
+
+# -- elementwise -------------------------------------------------------------
+
+def add_cpu(A, B, O, **_):
+    O[:] = A + B
+
+
+def add_tpu(A, B, O, **_):
+    return A + B
+
+
+def sub_cpu(A, B, O, **_):
+    O[:] = A - B
+
+
+def sub_tpu(A, B, O, **_):
+    return A - B
+
+
+def mul_cpu(A, B, O, **_):
+    O[:] = A * B
+
+
+def mul_tpu(A, B, O, **_):
+    return A * B
+
+
+def scale_cpu(A, O, alpha=1.0, **_):
+    O[:] = A * np.asarray(A).dtype.type(alpha)
+
+
+def scale_tpu(A, O, alpha=1.0, **_):
+    return A * jnp.asarray(alpha, A.dtype)
+
+
+# -- transpose ---------------------------------------------------------------
+
+def transpose_cpu(A, O, **_):
+    O[:] = np.asarray(A).T
+
+
+def transpose_tpu(A, O, **_):
+    return A.T
+
+
+# -- copy / redistribute -----------------------------------------------------
+# copy_* backs both the explicit same-tiling redistribute node and the
+# implicit private-copy classes in front of in-place consumers
+# (Cholesky mutates its working tiles; a source collection or a
+# multiply-consumed producer tile must never be that working set).
+
+def copy_cpu(A, O, **_):
+    O[:] = A
+
+
+def copy_tpu(A, O, **_):
+    # a jitted identity returns a fresh buffer (no aliasing without
+    # explicit donation) — the device-side private copy
+    return jnp.asarray(A)
+
+
+# -- forwarding reader (no-op body; the flow data itself is the product) ----
+
+def forward_cpu(X, **_):
+    pass
+
+
+# -- partial reductions (terminal sum/norm; f64 accumulators) ---------------
+
+def psum_cpu(A, S, **_):
+    S[:] = np.asarray(A, np.float64).sum()
+
+
+def psumsq_cpu(A, S, **_):
+    a = np.asarray(A, np.float64)
+    S[:] = (a * a).sum()

@@ -9,6 +9,7 @@ activations can name it.
 from __future__ import annotations
 
 import itertools
+import math
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, TYPE_CHECKING
@@ -184,6 +185,10 @@ class Taskpool:
         eta = None
         if known is not None and rate > 0:
             eta = max(0.0, (known - retired) / rate)
+            if not math.isfinite(eta):
+                # a 0-rate (or overflowed) extrapolation is UNKNOWN, not
+                # infinite: None here, "--" in the serve-status renderer
+                eta = None
         return {
             "taskpool_id": self.taskpool_id,
             "name": self.name,

@@ -49,6 +49,39 @@ def _overlap_1d(lo: int, hi: int, b: int):
     return range(first, last + 1)
 
 
+def resolve_redistribute_algo(algo: Optional[str], context) -> str:
+    """THE shared resolver of the redistribution data path — every entry
+    point (the module-level :func:`redistribute`, the array layer's
+    ``DistArray.redistribute``, benches) must come through here so the
+    algo string is parsed in exactly one place.
+
+    Precedence: a caller's explicit ``dtd``/``coll`` wins; an
+    *explicitly configured* MCA value (api/env/file source) wins over a
+    caller's literal ``"auto"`` — before this resolver existed a caller
+    passing ``algo="auto"`` shadowed an exported
+    ``PARSEC_MCA_runtime_redistribute_algo=dtd``; ``auto`` finally
+    resolves to ``coll`` on multi-rank meshes with a comm engine and
+    ``dtd`` otherwise."""
+    mca_val = str(mca_param.register(
+        "runtime", "redistribute_algo", "auto",
+        choices=["auto", "dtd", "coll"],
+        help="redistribution data path: dtd (all-pairs shadow-task "
+             "copies) | coll (memory-bounded collective rounds) | auto "
+             "(coll on multi-rank meshes)"))
+    if algo is None:
+        algo = mca_val
+    elif algo == "auto" and mca_param.params.source(
+            "runtime", "redistribute_algo") != "default":
+        algo = mca_val  # explicit MCA beats a caller's literal "auto"
+    if algo not in ("auto", "dtd", "coll"):
+        raise ValueError(
+            f"unknown redistribute algo {algo!r} (expected auto|dtd|coll)")
+    if algo == "auto":
+        algo = "coll" if (context is not None and context.nranks > 1
+                          and context.comm is not None) else "dtd"
+    return algo
+
+
 def redistribute(
     context,
     S: TiledMatrix,
@@ -81,15 +114,7 @@ def redistribute(
     _check_context_ranks(context, S, "redistribute")
     _check_context_ranks(context, T, "redistribute")
 
-    algo = algo or str(mca_param.register(
-        "runtime", "redistribute_algo", "auto",
-        choices=["auto", "dtd", "coll"],
-        help="redistribution data path: dtd (all-pairs shadow-task "
-             "copies) | coll (memory-bounded collective rounds) | auto "
-             "(coll on multi-rank meshes)"))
-    if algo == "auto":
-        algo = "coll" if (context is not None and context.nranks > 1
-                          and context.comm is not None) else "dtd"
+    algo = resolve_redistribute_algo(algo, context)
     if algo == "coll":
         return _redistribute_coll(context, S, T, m=m, n=n, ia=ia, ja=ja,
                                   ib=ib, jb=jb, mem_budget=mem_budget)

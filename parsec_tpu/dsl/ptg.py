@@ -433,6 +433,21 @@ class PTGTaskClass:
         self.flows.append(f)
         return self
 
+    def add_dep(self, flow_name: str, *deps: str) -> "PTGTaskClass":
+        """Append dependencies to an EXISTING flow.  Graph-synthesis
+        front-ends (:mod:`parsec_tpu.array`) build producer classes
+        before their consumers exist, then mirror the consumer edges
+        back onto the producer once they are known — JDF reciprocity
+        demands both sides, but a synthesizer discovers them one at a
+        time.  Only valid before ``taskpool()`` builds the vtables."""
+        for f in self.flows:
+            if f.name == flow_name:
+                for d in deps:
+                    dep = _parse_dep(d)
+                    (f.deps_in if dep.is_input else f.deps_out).append(dep)
+                return self
+        raise ValueError(f"class {self.name}: no flow {flow_name!r}")
+
     def ctl(self, name: str, *deps: str) -> "PTGTaskClass":
         return self.flow(name, CTL, *deps)
 

@@ -110,8 +110,9 @@ class PanelCholesky:
 
     def __init__(self, n: int, nb: int = 512, *, bucket: int = 8,
                  bf16: bool = False, strip: int = 0, device=None):
-        if n % nb:
-            raise ValueError(f"N={n} not divisible by nb={nb}")
+        from .tiles import check_tiling
+
+        check_tiling(n, nb, op="panel cholesky")
         if bf16 == "storage":
             raise ValueError(
                 "PanelCholesky does not implement bf16='storage' — use "
@@ -186,10 +187,11 @@ class WholeCholesky:
 
     def __init__(self, n: int, nb: int = 512, *, bf16=False,
                  strip: int = 4096):
-        if n % nb:
-            raise ValueError(f"N={n} not divisible by nb={nb}")
-        if strip % nb:
-            raise ValueError(f"strip {strip} must be a multiple of nb {nb}")
+        from .tiles import check_tiling
+
+        check_tiling(n, nb, op="whole cholesky")
+        if strip:
+            check_tiling(strip, nb, what="strip", op="whole cholesky")
         #: ``bf16``: False = storage precision; True = bf16 operand casts
         #: (f32 accumulate/storage); "storage" = the matrix lives in
         #: bf16 — HALF the HBM traffic, the binding constraint at

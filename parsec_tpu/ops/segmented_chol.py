@@ -279,11 +279,11 @@ def segmented_cholesky_ptg(n: int, nb: int, *, bf16=False,
     CQR2 is traced per program).  QR and LU default to generic, where
     the measured trade runs the other way (segmented_qr.py /
     segmented_lu.py)."""
-    if n % nb:
-        raise ValueError(f"N={n} not divisible by nb={nb}")
+    from .tiles import check_tiling
+
+    check_tiling(n, nb, op="segmented cholesky")
     strip = min(strip, n)
-    if strip % nb:
-        raise ValueError(f"strip {strip} must be a multiple of nb {nb}")
+    check_tiling(strip, nb, what="strip", op="segmented cholesky")
     kt = n_segments(n, nb, tail) - 1  # single source of truth for the
     # fused-tail boundary: NT and the baked kt must never desync
     ptg = PTG("dpotrf_seg")

@@ -137,8 +137,9 @@ def dist_segmented_cholesky_ptg(n: int, nb: int, *, use_tpu: bool = True,
     an evaluate hook — eligible only in contexts with no TPU device (the
     TCP driver's CPU-only subprocesses), never competing for device-run
     tasks."""
-    if n % nb:
-        raise ValueError(f"N={n} not divisible by nb={nb}")
+    from .tiles import check_tiling
+
+    check_tiling(n, nb, op="distributed segmented cholesky")
     ptg = PTG("dpotrf_seg_dist")
     panel = ptg.task_class("panel", k="0 .. NT-1")
     panel.affinity("C(k)")

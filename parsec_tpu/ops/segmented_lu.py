@@ -386,11 +386,11 @@ def segmented_lu_ptg(n: int, nb: int, *, strip: int = 4096,
     to ``prec`` (``pivot="panel"`` defaults to HIGHEST — that path never
     took the round-5 solve downgrade).  Pass ``Precision.HIGHEST`` to
     restore the pre-round-5 6-pass solves (at ~2x the f32 panel cost)."""
-    if n % nb:
-        raise ValueError(f"N={n} not divisible by nb={nb}")
+    from .tiles import check_tiling
+
+    check_tiling(n, nb, op="segmented LU")
     strip = min(strip, n)
-    if strip % nb:
-        raise ValueError(f"strip {strip} must be a multiple of nb {nb}")
+    check_tiling(strip, nb, what="strip", op="segmented LU")
     if prec is None:
         prec = Precision.HIGH
     kt = n_segments(n, nb, tail) - 1

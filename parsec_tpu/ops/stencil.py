@@ -48,7 +48,12 @@ class StencilBuffers(DataCollection):
         super().__init__(name, nodes=nodes, myrank=myrank)
         self.mt, self.nt = mt, nt
         h, w = grid.shape
-        assert h % mt == 0 and w % nt == 0
+        # shared tiling check (ops.tiles.check_tiling): a non-dividing
+        # grid used to be a bare assert — silently truncated under -O
+        from .tiles import check_tiling
+
+        check_tiling(h, mt, what="grid rows", op="stencil")
+        check_tiling(w, nt, what="grid cols", op="stencil")
         self.th, self.tw = h // mt, w // nt
         self.dtype = grid.dtype
         self._rank_of = rank_of

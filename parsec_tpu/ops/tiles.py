@@ -26,13 +26,45 @@ except Exception:  # pragma: no cover
     jax = None
 
 
+# -- tiling validation ------------------------------------------------------
+
+def check_tiling(n: int, nb: int, *, what: str = "N", op: str = "op",
+                 allow_ragged: bool = False) -> int:
+    """Validate a 1-D tiling and return the tile count.
+
+    ONE shared check for every builder that cuts a size-``n`` extent into
+    ``nb``-sized tiles: ``nb`` must be a positive tile size no larger than
+    makes sense, and — unless ``allow_ragged`` — divide ``n`` exactly.
+    Before this existed the builders disagreed: the segmented
+    factorizations rejected a non-dividing ``nb`` with a bare message,
+    the stencil buffers *asserted* (silent truncation under ``python -O``),
+    and each op spelled the error differently.  The array layer
+    (:mod:`parsec_tpu.array`) supports ragged tails and calls this with
+    ``allow_ragged=True`` for the positivity checks alone."""
+    if int(nb) != nb or int(n) != n:
+        raise ValueError(f"{op}: {what}={n!r} / tile size {nb!r} must be "
+                         "integers")
+    n, nb = int(n), int(nb)
+    if nb <= 0:
+        raise ValueError(f"{op}: tile size {nb} for {what} must be positive")
+    if n <= 0:
+        raise ValueError(f"{op}: {what}={n} must be positive")
+    if not allow_ragged and n % nb:
+        raise ValueError(
+            f"{op}: {what}={n} is not divisible by {nb} "
+            f"(the tile cut would leave a ragged remainder of {n % nb}; "
+            f"pick a value dividing {what}, or an op that supports "
+            "ragged tiles)")
+    return (n + nb - 1) // nb
+
+
 # -- GEMM -------------------------------------------------------------------
 
-def gemm_cpu(a, b, c):
+def gemm_cpu(a, b, c, **_):
     c += a @ b
 
 
-def gemm_tpu(a, b, c):
+def gemm_tpu(a, b, c, **_):
     return c + jnp.dot(a, b, precision="highest")
 
 
@@ -134,3 +166,25 @@ def gemm_update_pallas_bf16(A, B1, B2, **_):
 
     return matmul_update(A, B1.astype(jnp.bfloat16),
                          B2.astype(jnp.bfloat16), alpha=-1.0)
+
+
+# -- forward substitution (left lower-triangular solve) ---------------------
+# The tile kernels of x = L^{-1} b: the array layer's solve() graphs
+# (parsec_tpu.array) thread the right-hand side through a per-row
+# accumulation chain (gemm_sub) ending in the diagonal solve (trsv_fwd),
+# which writes the result tile X in place (CPU) / returns it (device).
+
+def trsv_fwd_cpu(D, R, X, **_):
+    X[:] = np.linalg.solve(np.tril(D), R)
+
+
+def trsv_fwd_tpu(D, R, X, **_):
+    return _jsolve(D, R, lower=True, trans=0)
+
+
+def gemm_sub_cpu(L, X, R, **_):
+    R -= L @ X
+
+
+def gemm_sub_tpu(L, X, R, **_):
+    return R - jnp.dot(L, X, precision="highest")

@@ -56,7 +56,10 @@ COLL_SPAN_NAMES = ("coll",)
 #: up under one ``attention`` row, so "how much of the chain is
 #: attention" reads off one line however many classes the graph has
 CLASS_LABELS: Dict[str, str] = {}
-PREFIX_LABELS: Tuple[Tuple[str, str], ...] = (("attn_", "attention"),)
+PREFIX_LABELS: Tuple[Tuple[str, str], ...] = (
+    ("attn_", "attention"),
+    ("arr_", "array"),  # generated array-front-end classes (PR 13)
+)
 
 
 def label_of(cls: str) -> Optional[str]:

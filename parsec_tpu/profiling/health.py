@@ -99,6 +99,19 @@ def _coll_summary(ctx) -> Optional[Dict[str, Any]]:
     return mgr.summary()
 
 
+def _array_summary() -> Dict[str, Any]:
+    """Array-front-end synthesis counters (``parsec_array_*`` on
+    /metrics, the ``PARSEC::ARRAY::*`` SDE gauges).  Process-wide and
+    import-light: zeros until the first program lowers."""
+    import sys
+
+    mod = sys.modules.get("parsec_tpu.array.lower")
+    if mod is None:  # never imported: nothing lowered, report zeros
+        return {"programs_lowered": 0, "classes_generated": 0,
+                "taskpools_built": 0}
+    return mod.counters()
+
+
 def _device_summary(dev) -> Dict[str, Any]:
     s = getattr(dev, "stats", {})
     waves = int(s.get("wave_submits", 0))
@@ -148,6 +161,7 @@ def context_status(ctx) -> Dict[str, Any]:
         "arena": arena_mod.global_stats(),
         "comm": _comm_summary(ctx),
         "coll": _coll_summary(ctx),
+        "array": _array_summary(),
         "devices": [_device_summary(d) for d in ctx.devices],
         "sde": {name: sde.read(name) for name in sde.list_counters()
                 if name not in own},
@@ -259,6 +273,20 @@ def register_context_gauges(ctx) -> Callable[[], None]:
               int(d.stats.get("fused_tasks", 0))
               - int(d.stats.get("fused_submits", 0))
               for d in ctx.devices)))
+
+    # array-front-end synthesis counters (parsec_tpu.array): process-wide
+    # monotone counters, zero until the first program lowers — registered
+    # unconditionally so the doc'd gauge set is always live
+    def array_val(key: str):
+        def get() -> float:
+            # import-light like _array_summary: a metrics scrape must not
+            # pull the array package into a process that never used it
+            return float(_array_summary().get(key, 0))
+        return get
+
+    gauge(sde.ARRAY_PROGRAMS_LOWERED, array_val("programs_lowered"))
+    gauge(sde.ARRAY_CLASSES_GENERATED, array_val("classes_generated"))
+    gauge(sde.ARRAY_TASKPOOLS_BUILT, array_val("taskpools_built"))
 
     # serving-plane counters (serve.RuntimeService on ctx.serve): zero
     # until a service attaches — registered unconditionally so external
@@ -441,6 +469,16 @@ def prometheus_text(ctx) -> str:
                   t["rate_tasks_per_s"])
             if t["eta_s"] is not None:
                 _line(out, "parsec_tenant_eta_seconds", lab, t["eta_s"])
+
+    ar = doc.get("array") or {}
+    if ar:
+        out.append("# TYPE parsec_array_programs_total counter")
+        _line(out, "parsec_array_programs_total", r,
+              ar.get("programs_lowered", 0))
+        _line(out, "parsec_array_classes_total", r,
+              ar.get("classes_generated", 0))
+        _line(out, "parsec_array_taskpools_total", r,
+              ar.get("taskpools_built", 0))
 
     wd = doc["watchdog"]
     _line(out, "parsec_watchdog_stalled", r,

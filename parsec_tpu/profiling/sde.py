@@ -56,6 +56,11 @@ COLL_SEGMENTS_INFLIGHT = "PARSEC::COLL::SEGMENTS_INFLIGHT"
 FUSION_REGIONS_DISPATCHED = "PARSEC::FUSION::REGIONS_DISPATCHED"
 FUSION_TASKS_FUSED = "PARSEC::FUSION::TASKS_FUSED"
 FUSION_DISPATCH_SAVED = "PARSEC::FUSION::DISPATCH_SAVED"
+# array-front-end synthesis counters (parsec_tpu.array.lower.counters —
+# process-wide, 0 until the first array program lowers)
+ARRAY_PROGRAMS_LOWERED = "PARSEC::ARRAY::PROGRAMS_LOWERED"
+ARRAY_CLASSES_GENERATED = "PARSEC::ARRAY::CLASSES_GENERATED"
+ARRAY_TASKPOOLS_BUILT = "PARSEC::ARRAY::TASKPOOLS_BUILT"
 # serving-plane counters (serve.RuntimeService.status_doc — read 0 when
 # no service is attached to the context)
 SERVE_JOBS_QUEUED = "PARSEC::SERVE::JOBS_QUEUED"

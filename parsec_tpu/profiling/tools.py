@@ -308,6 +308,16 @@ def _lint_one(target: str, overrides: Dict[str, Any], ignore):
             return target, lint_jdf(jdf, ignore=ignore), notes
         return target, lint_jdf(jdf, consts, ignore=ignore,
                                 fusion_hints=True), notes
+    if target.startswith("array:"):
+        # canonical array-front-end programs (parsec_tpu.array): lint
+        # the GENERATED graph exactly as lower() emits it
+        from ..array import canonical_program
+
+        prog = canonical_program(target.partition(":")[2] or "mixed")
+        consts = prog.constants
+        consts.update(overrides)
+        return target, verify_ptg(prog.ptg, consts, ignore=ignore,
+                                  fusion_hints=True), notes
     if ":" in target:
         from ..analysis.linter import collection_names, free_symbols
 
@@ -511,9 +521,15 @@ def cmd_serve_status(args) -> int:
            f"{'fail':>5}{'rej':>5}{'retired':>9}{'tasks/s':>9}"
            f"{'eta_s':>7}")
     print(hdr)
+    import math as _math
+
     for name in sorted(sv["tenants"]):
         t = sv["tenants"][name]
-        eta = f"{t['eta_s']:.1f}" if t["eta_s"] is not None else "-"
+        # unknown ETA (no rate yet, or a non-finite extrapolation from a
+        # 0-rate window) renders as "--", never "inf"
+        eta = ("--" if t["eta_s"] is None
+               or not _math.isfinite(float(t["eta_s"]))
+               else f"{float(t['eta_s']):.1f}")
         print(f"  {name:<16}{t['weight']:>3}{t['inflight']:>5}"
               f"{t['queued']:>6}{t['completed']:>6}{t['failed']:>5}"
               f"{t['rejected']:>5}{t['retired']:>9}"

@@ -1,0 +1,124 @@
+"""Observability slices of generated array programs: the
+PARSEC::ARRAY::* SDE gauge set (registered with the context gauges,
+documented in OPERATIONS.md), /metrics export, and the critpath
+``per_label`` rollup of ``arr_*`` classes under one ``array`` row."""
+
+import json
+import re
+import urllib.request
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu import array as pa
+from parsec_tpu.profiling import sde
+
+
+@pytest.fixture
+def clean_sde():
+    sde.reset()
+    yield
+    sde.reset()
+
+
+def test_array_sde_gauges_track_synthesis(clean_sde):
+    from parsec_tpu.profiling.health import register_context_gauges
+
+    ctx = Context(nb_cores=2)
+    unregister = register_context_gauges(ctx)
+    try:
+        base = sde.read(sde.ARRAY_PROGRAMS_LOWERED)
+        A = pa.from_numpy(np.eye(8), 4)
+        (A + A).compute(ctx, use_tpu=False)
+        assert sde.read(sde.ARRAY_PROGRAMS_LOWERED) == base + 1
+        assert sde.read(sde.ARRAY_CLASSES_GENERATED) > 0
+        assert sde.read(sde.ARRAY_TASKPOOLS_BUILT) >= 1
+    finally:
+        unregister()
+        ctx.fini()
+
+
+def test_array_gauges_on_metrics_endpoint(clean_sde):
+    from parsec_tpu.profiling.health import (
+        HealthServer,
+        register_context_gauges,
+    )
+
+    ctx = Context(nb_cores=2)
+    register_context_gauges(ctx)
+    hs = HealthServer(ctx).start()
+    try:
+        A = pa.from_numpy(np.eye(8), 4)
+        (A * 2.0).compute(ctx, use_tpu=False)
+        text = urllib.request.urlopen(hs.url + "/metrics",
+                                      timeout=10).read().decode()
+        m = re.search(r'parsec_array_programs_total\{rank="0"\} (\d+)',
+                      text)
+        assert m and int(m.group(1)) >= 1, text[-500:]
+        assert 'parsec_array_taskpools_total{rank="0"}' in text
+        # the SDE registry reads the same numbers
+        assert sde.read(sde.ARRAY_PROGRAMS_LOWERED) >= 1
+        st = json.loads(urllib.request.urlopen(
+            hs.url + "/status", timeout=10).read().decode())
+        assert st["array"]["programs_lowered"] >= 1
+    finally:
+        hs.stop()
+        ctx.fini()
+
+
+def test_operations_md_documents_array_gauges():
+    """Doc-drift guard, the documented side: the ARRAY gauge set must
+    have OPERATIONS.md rows (test_health pins the registered side)."""
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    ops_md = os.path.join(here, "..", "..", "docs", "OPERATIONS.md")
+    with open(ops_md) as f:
+        documented = set(re.findall(r"`(PARSEC::[A-Z_:]+)`", f.read()))
+    assert {sde.ARRAY_PROGRAMS_LOWERED, sde.ARRAY_CLASSES_GENERATED,
+            sde.ARRAY_TASKPOOLS_BUILT} <= documented
+
+
+def test_critpath_per_label_rolls_arr_classes():
+    from parsec_tpu.profiling.critpath import label_of
+
+    assert label_of("arr_mm3") == "array"
+    assert label_of("arr_po7") == "array"
+    assert label_of("arr_ldf0") == "array"
+    assert label_of("fused[arr_ew2+arr_sc3]") == "array"
+    assert label_of("potrf") is None
+
+
+def test_critpath_real_trace_array_label(tmp_path):
+    """A traced array-program run attributes its critical path under
+    ONE `array` per_label row."""
+    from parsec_tpu import native
+
+    if not native.available():
+        pytest.skip("critpath needs the native tracer")
+    from parsec_tpu.profiling import critpath
+    from parsec_tpu.profiling.binary import RankTraceSet
+    from parsec_tpu.profiling.merge import merge_traces
+
+    traces = RankTraceSet(1).install()
+    try:
+        rng = np.random.default_rng(3)
+        G = rng.standard_normal((16, 16))
+        A = pa.from_numpy(G, 4)
+        M = (A @ A.T) + A
+        with Context(nb_cores=2) as ctx:
+            M.compute(ctx, use_tpu=False)
+        paths = traces.dump(str(tmp_path))
+    finally:
+        traces.uninstall()
+    merged = str(tmp_path / "merged.json")
+    merge_traces(paths, merged)
+    with open(merged) as f:
+        events = json.load(f)["traceEvents"]
+    rep = critpath.analyze(events)
+    assert rep["n_tasks"] > 0
+    assert "array" in rep["per_label"], rep["per_class"]
+    lab = rep["per_label"]["array"]
+    assert lab["count"] > 0
+    assert "array" in critpath.render(rep)

@@ -1,0 +1,58 @@
+"""Deterministic schedule-explorer leg over a mixed array program
+(matmul -> cholesky -> solve) at 2 virtual ranks: every seed must
+quiesce, produce bit-identical result tiles, and pass a clean hb-check
+— the concurrency-correctness gate for generated graphs."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu import array as pa
+from parsec_tpu.analysis.schedules import explore
+
+N, NB, NR = 24, 8, 2
+_rng = np.random.default_rng(43)
+G = _rng.standard_normal((N, N))
+H = np.eye(N) * N
+RHS = _rng.standard_normal((N, 2))
+
+
+def _build(rank, ctx):
+    dist = pa.Block1D(NR)
+    A = pa.from_numpy(G, NB, dist=dist, myrank=rank)
+    B = pa.from_numpy(H, NB, dist=dist, myrank=rank)
+    b = pa.from_numpy(RHS, NB, 2, dist=dist, myrank=rank)
+    C = (A @ A.T + B).cholesky()
+    x = C.solve(b)
+    prog = pa.lower([x, C], use_tpu=False)
+    prog.finalize()  # collections exist now; tiles land at quiescence
+    return prog.taskpool(ctx), [C._node.coll, x._node.coll]
+
+
+def _snapshot(users):
+    from parsec_tpu.analysis.schedules import tile_digest
+
+    return [tile_digest(c) for ranks in users for c in ranks]
+
+
+def test_mixed_array_program_explorer_4_seeds():
+    res = explore(_build, nranks=NR, seeds=range(4), snapshot=_snapshot,
+                  timeout=180)
+    assert len(res.seeds) == 4 and not res.errors
+    # bit-identity across seeds was asserted by explore(); also pin the
+    # tiles are CORRECT, not identically wrong: rank 0's factor tiles
+    L = np.tril(np.linalg.cholesky(G @ G.T + H))
+    c0_digest = res.digests[res.seeds[0]][0]  # rank 0's C collection
+    assert c0_digest, "rank 0 produced no factor tiles"
+    for (i, j), entry in c0_digest.items():
+        shape, dtype, raw = entry
+        got = np.frombuffer(raw, dtype).reshape(shape)
+        np.testing.assert_allclose(
+            got, L[i * NB:i * NB + shape[0], j * NB:j * NB + shape[1]],
+            atol=1e-10, err_msg=f"tile {(i, j)}")
+
+
+@pytest.mark.slow
+def test_mixed_array_program_explorer_25_seeds():
+    res = explore(_build, nranks=NR, seeds=range(25), snapshot=_snapshot,
+                  timeout=300)
+    assert len(res.seeds) == 25 and not res.errors

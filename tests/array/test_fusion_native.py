@@ -1,0 +1,122 @@
+"""Execution-path parity for generated array programs: supertask fusion
+on vs off bit-identical, the native engine (PR-3 ASYNC path) vs the
+dynamic runtime bit-identical, and executable-cache reuse across
+programs (PR-7)."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu import array as pa
+from parsec_tpu.utils import mca_param
+
+
+@pytest.fixture
+def fusion_off_guard():
+    yield
+    mca_param.params.unset("runtime", "fusion")
+
+
+def _chain_arrays(dtype=np.float32, n=64, nb=16, seed=5):
+    rng = np.random.default_rng(seed)
+    G = rng.standard_normal((n, n)).astype(dtype)
+    H = rng.standard_normal((n, n)).astype(dtype)
+    A = pa.from_numpy(G, nb)
+    B = pa.from_numpy(H, nb)
+    return ((A + B) * 0.5 - B).scale(2.0), G, H
+
+
+def _run_chain(fuse: bool):
+    mca_param.params.set("runtime", "fusion", "auto" if fuse else "off")
+    out, G, H = _chain_arrays()
+    with Context(nb_cores=2) as ctx:
+        out.compute(ctx, use_cpu=False, use_tpu=True)
+        devs = ctx.devices
+    stats = {k: sum(d.stats.get(k, 0) for d in devs)
+             for k in ("fused_submits", "fused_tasks")}
+    return out.to_numpy(), stats
+
+
+def test_fused_chain_bit_identical(fusion_off_guard):
+    """Elementwise chains are the canonical fusible shape: fusion must
+    engage (regions actually dispatch fused) and be bit-neutral."""
+    off, stats_off = _run_chain(False)
+    on, stats_on = _run_chain(True)
+    assert np.array_equal(off, on), "fusion changed array numerics"
+    assert stats_off["fused_submits"] == 0
+    assert stats_on["fused_submits"] > 0
+    assert stats_on["fused_tasks"] > stats_on["fused_submits"]
+
+
+def test_fused_mixed_program_bit_identical(fusion_off_guard):
+    """The mixed matmul→cholesky→solve program, fusion on vs off, CPU
+    bodies (fusion only coarsens device regions — the program must stay
+    bit-identical when nothing is eligible too)."""
+    rng = np.random.default_rng(9)
+    n, nb = 24, 8
+    G = rng.standard_normal((n, n))
+    H = np.eye(n) * n
+    rhs = rng.standard_normal((n, 2))
+
+    def run(fuse):
+        mca_param.params.set("runtime", "fusion",
+                             "auto" if fuse else "off")
+        A = pa.from_numpy(G, nb)
+        B = pa.from_numpy(H, nb)
+        b = pa.from_numpy(rhs, nb, 2)
+        C = (A @ A.T + B).cholesky()
+        x = C.solve(b)
+        with Context(nb_cores=2) as ctx:
+            x.compute(ctx, others=[C], use_tpu=False)
+        return C.to_numpy().tobytes(), x.to_numpy().tobytes()
+
+    assert run(False) == run(True)
+
+
+def test_native_engine_matches_dynamic():
+    """run_native (PR-3 native ASYNC engine) executes the generated
+    taskpool bit-identically to the dynamic runtime."""
+    rng = np.random.default_rng(13)
+    n, nb = 20, 8  # ragged tail
+    G = rng.standard_normal((n, n))
+    H = np.eye(n) * n
+    rhs = rng.standard_normal((n, 2))
+
+    def build():
+        A = pa.from_numpy(G, nb)
+        B = pa.from_numpy(H, nb)
+        b = pa.from_numpy(rhs, nb, 2)
+        C = (A @ A.T + B).cholesky()
+        return C, C.solve(b)
+
+    C1, x1 = build()
+    with Context(nb_cores=2) as ctx:
+        x1.compute(ctx, others=[C1], use_tpu=False)
+    C2, x2 = build()
+    prog = pa.lower([x2, C2], use_tpu=False)
+    prog.run_native(nthreads=4)
+    assert x1.to_numpy().tobytes() == x2.to_numpy().tobytes()
+    assert C1.to_numpy().tobytes() == C2.to_numpy().tobytes()
+
+
+def test_device_programs_key_into_executable_cache():
+    """The second identical array program compiles NOTHING: its device
+    bodies resolve through the PR-7 executable cache."""
+    pytest.importorskip("jax")
+    rng = np.random.default_rng(17)
+    G = rng.standard_normal((32, 32)).astype(np.float32)
+
+    def run():
+        A = pa.from_numpy(G, 16)
+        B = pa.from_numpy(G.T.copy(), 16)
+        out = (A @ B) + A
+        with Context(nb_cores=2) as ctx:
+            out.compute(ctx, use_cpu=False, use_tpu=True)
+            snap = dict(ctx.compile_cache.stats)
+        return out.to_numpy(), snap
+
+    r1, s1 = run()
+    r2, s2 = run()
+    assert np.array_equal(r1, r2)
+    compiles_second = (s2.get("compiles", 0) - s1.get("compiles", 0))
+    assert compiles_second == 0, (s1, s2)

@@ -1,0 +1,308 @@
+"""Numerics matrix for the array front-end vs numpy oracles:
+matmul / cholesky / solve / elementwise / transpose / sum / norm, f32 &
+f64 CPU bodies plus bf16 device bodies, non-dividing tails, and 1/2/4
+virtual ranks (the distributed legs ride the inproc fabric)."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu import array as pa
+
+from tests.runtime.test_multirank import run_ranks
+
+
+def _spd(n, rng, dtype=np.float64):
+    G = rng.standard_normal((n, n)).astype(dtype)
+    return G, (G @ G.T + n * np.eye(n, dtype=dtype)).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# single rank
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,nb", [(16, 4), (20, 8)])  # (20, 8): ragged tail
+@pytest.mark.parametrize("dtype", [np.float64, np.float32])
+def test_mixed_program_vs_oracle(n, nb, dtype):
+    """The acceptance program ``C = cholesky(A @ A.T + B); x =
+    solve(C, b)`` as ONE taskpool, vs the numpy factorization."""
+    rng = np.random.default_rng(7)
+    G, _ = _spd(n, rng, dtype)
+    H = (np.eye(n) * n).astype(dtype)
+    rhs = rng.standard_normal((n, 2)).astype(dtype)
+
+    A = pa.from_numpy(G, nb)
+    B = pa.from_numpy(H, nb)
+    b = pa.from_numpy(rhs, nb, 2)
+    C = (A @ A.T + B).cholesky()
+    x = C.solve(b)
+    before = pa.counters()
+    with Context(nb_cores=2) as ctx:
+        x.compute(ctx, others=[C], use_tpu=False)
+    after = pa.counters()
+    # ONE program, ONE taskpool for the whole five-op chain
+    assert after["programs_lowered"] == before["programs_lowered"] + 1
+    assert after["taskpools_built"] == before["taskpools_built"] + 1
+    spd = (G @ G.T + H).astype(np.float64)
+    L = np.linalg.cholesky(spd)
+    tol = 1e-10 if dtype == np.float64 else 2e-3
+    assert np.allclose(np.tril(C.to_numpy()), L, atol=tol)
+    # the upper triangle is structurally zero, not input junk
+    assert np.count_nonzero(np.triu(C.to_numpy(), 1)) == 0
+    assert np.allclose(x.to_numpy(), np.linalg.solve(L, rhs), atol=tol)
+
+
+def test_elementwise_transpose_scale_chain():
+    rng = np.random.default_rng(11)
+    G = rng.standard_normal((18, 10))  # ragged in both dims under (8, 4)
+    H = rng.standard_normal((18, 10))
+    A = pa.from_numpy(G, 8, 4)
+    B = pa.from_numpy(H, 8, 4)
+    out = ((A + B) * 0.25 - B).T
+    with Context(nb_cores=2) as ctx:
+        out.compute(ctx, use_tpu=False)
+    want = ((G + H) * 0.25 - H).T
+    assert np.allclose(out.to_numpy(), want, atol=1e-12)
+    assert out.shape == (10, 18)
+
+
+def test_hadamard_and_rectangular_matmul():
+    rng = np.random.default_rng(13)
+    G = rng.standard_normal((12, 20))
+    H = rng.standard_normal((20, 8))
+    W = rng.standard_normal((12, 8))
+    A = pa.from_numpy(G, 4, 8)
+    B = pa.from_numpy(H, 8, 4)
+    Wd = pa.from_numpy(W, 4, 4)
+    out = (A @ B) * Wd
+    with Context(nb_cores=2) as ctx:
+        out.compute(ctx, use_tpu=False)
+    assert np.allclose(out.to_numpy(), (G @ H) * W, atol=1e-12)
+
+
+def test_single_tile_program():
+    """NT == 1 degenerate shapes: every class family with an empty
+    parameter space must still exist (the release path resolves class
+    NAMES before discovering a range is empty — a dep naming a
+    never-created class is a KeyError, regression-pinned here)."""
+    rng = np.random.default_rng(5)
+    G = rng.standard_normal((4, 4))
+    spd = G @ G.T + 4 * np.eye(4)
+    rhs = rng.standard_normal((4, 1))
+    A = pa.from_numpy(spd, 4)
+    b = pa.from_numpy(rhs, 4, 1)
+    C = A.cholesky()
+    x = C.solve(b)
+    prog = pa.lower([x, C], use_tpu=False)
+    assert prog.verify() == []
+    with Context(nb_cores=2) as ctx:
+        prog.run(ctx, timeout=60)
+    L = np.linalg.cholesky(spd)
+    assert np.allclose(C.to_numpy(), np.tril(L), atol=1e-10)
+    assert np.allclose(x.to_numpy(), np.linalg.solve(L, rhs), atol=1e-10)
+
+
+def test_sum_and_norm_ride_reductions():
+    rng = np.random.default_rng(17)
+    G = rng.standard_normal((20, 12))
+    A = pa.from_numpy(G, 8, 4)
+    with Context(nb_cores=2) as ctx:
+        s = (A * A).sum(ctx, use_tpu=False)
+        nrm = A.norm(ctx, use_tpu=False)
+    assert abs(s - (G * G).sum()) < 1e-9
+    assert abs(nrm - np.linalg.norm(G)) < 1e-9
+
+
+def test_bf16_device_bodies():
+    """bf16 tiles through the device incarnations (jit via the
+    executable cache): bf16-class numerics vs the f32 oracle."""
+    pytest.importorskip("jax")
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    rng = np.random.default_rng(19)
+    G = rng.standard_normal((32, 32)).astype(np.float32)
+    H = rng.standard_normal((32, 32)).astype(np.float32)
+    A = pa.from_numpy(G, 8, dtype=bf16)
+    B = pa.from_numpy(H, 8, dtype=bf16)
+    out = (A @ B) + (A + B)
+    with Context(nb_cores=2) as ctx:
+        out.compute(ctx, use_cpu=False, use_tpu=True)
+    got = np.asarray(out.to_numpy(), np.float32)
+    want = (G.astype(bf16).astype(np.float32)
+            @ H.astype(bf16).astype(np.float32)) + (
+        G.astype(bf16).astype(np.float32)
+        + H.astype(bf16).astype(np.float32))
+    assert np.allclose(got, want, rtol=0.1, atol=0.5)
+
+
+def test_compute_is_idempotent_and_reusable():
+    """A computed array acts as a leaf: the next program reads its
+    collection instead of re-running the producer graph."""
+    rng = np.random.default_rng(23)
+    G = rng.standard_normal((16, 16))
+    A = pa.from_numpy(G, 4)
+    M = A @ A.T
+    with Context(nb_cores=2) as ctx:
+        M.compute(ctx, use_tpu=False)
+        assert M.computed
+        built = pa.counters()["taskpools_built"]
+        M.compute(ctx, use_tpu=False)  # no-op: already materialized
+        assert pa.counters()["taskpools_built"] == built
+        out = (M + M).compute(ctx, use_tpu=False)
+    assert np.allclose(out.to_numpy(), 2 * (G @ G.T), atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# 2 / 4 virtual ranks (inproc fabric, SPMD builds)
+# ---------------------------------------------------------------------------
+
+def _mixed_distributed(nranks, n=32, nb=8, q=1):
+    rng = np.random.default_rng(29)
+    G, spd = _spd(n, rng)
+    H = np.eye(n) * n
+    rhs = rng.standard_normal((n, 2))
+    L = np.linalg.cholesky(G @ G.T + H)
+    xo = np.linalg.solve(L, rhs)
+    outs = {}
+
+    def build(rank, ctx):
+        p = nranks // q
+        dist = pa.BlockCyclic(p, q)
+        A = pa.from_numpy(G, nb, dist=dist, myrank=rank)
+        B = pa.from_numpy(H, nb, dist=dist, myrank=rank)
+        b = pa.from_numpy(rhs, nb, 2, dist=pa.BlockCyclic(p, q),
+                          myrank=rank)
+        C = (A @ A.T + B).cholesky()
+        x = C.solve(b)
+        prog = pa.lower([x, C], use_tpu=False)
+        outs[rank] = (prog, C, x)
+        return prog.taskpool(ctx)
+
+    run_ranks(nranks, build, timeout=180)
+
+    for rank in range(nranks):
+        prog, C, x = outs[rank]
+        prog.finalize()
+        cl = C._node.coll
+        for (i, j) in cl.local_tiles():
+            h, w = cl.tile_shape(i, j)
+            want = np.tril(L)[i * nb:i * nb + h, j * nb:j * nb + w]
+            got = np.asarray(cl.data_of(i, j).newest_copy().payload)[:h, :w]
+            np.testing.assert_allclose(got, want, atol=1e-10,
+                                       err_msg=f"L tile {(i, j)} rank {rank}")
+        xl = x._node.coll
+        for (i, j) in xl.local_tiles():
+            h, w = xl.tile_shape(i, j)
+            want = xo[i * nb:i * nb + h, j * 2:j * 2 + w]
+            got = np.asarray(xl.data_of(i, j).newest_copy().payload)[:h, :w]
+            np.testing.assert_allclose(got, want, atol=1e-10,
+                                       err_msg=f"x tile {(i, j)} rank {rank}")
+
+
+def test_mixed_program_2_ranks():
+    _mixed_distributed(2)
+
+
+def test_mixed_program_4_ranks_2x2_grid():
+    _mixed_distributed(4, q=2)
+
+
+def test_distributed_sum_allreduce():
+    """sum() folds local partials and allreduces across ranks through
+    the CollManager — every rank gets the global value."""
+    n, nb, NR = 24, 8, 2
+    rng = np.random.default_rng(31)
+    G = rng.standard_normal((n, n))
+    sums = {}
+
+    def build(rank, ctx):
+        A = pa.from_numpy(G, nb, dist=pa.Block1D(NR), myrank=rank)
+        sums[rank] = A.sum(ctx, use_tpu=False)
+        from parsec_tpu.dsl.dtd import DTDTaskpool
+
+        return DTDTaskpool(ctx, name="noop")
+
+    run_ranks(NR, build, timeout=120)
+    for rank in range(NR):
+        assert abs(sums[rank] - G.sum()) < 1e-9, rank
+
+
+def test_sequential_programs_on_one_mesh():
+    """Regression: remote activations route by POOL NAME, so a stream
+    of same-named array pools on a rank-skewed mesh used to cross-talk
+    (rank A's next pool reaching rank B's previous registration —
+    KeyError / wedged dep counters).  taskpool(ctx) draws an
+    SPMD-consistent sequence suffix per program, so per-op chains on
+    one persistent mesh complete."""
+    NR, n, nb = 2, 48, 8
+    rng = np.random.default_rng(41)
+    G = rng.standard_normal((n, n))
+    H = np.eye(n) * n
+    L = np.linalg.cholesky(G @ G.T + H)
+    outs = {}
+
+    def build(rank, ctx):
+        dist = pa.Block1D(NR)
+        kw = dict(use_tpu=False, timeout=90)
+        A = pa.from_numpy(G, nb, dist=dist, myrank=rank)
+        B = pa.from_numpy(H, nb, dist=dist, myrank=rank)
+        t = A.T
+        t.compute(ctx, **kw)
+        m1 = A @ t
+        m1.compute(ctx, **kw)
+        m2 = m1 + B
+        m2.compute(ctx, **kw)
+        C = m2.cholesky()
+        C.compute(ctx, **kw)
+        outs[rank] = C
+        from parsec_tpu.dsl.dtd import DTDTaskpool
+
+        return DTDTaskpool(ctx, name=f"noop{rank}")
+
+    run_ranks(NR, build, timeout=240)
+    for rank in range(NR):
+        cl = outs[rank]._node.coll
+        for (i, j) in cl.local_tiles():
+            h, w = cl.tile_shape(i, j)
+            got = np.asarray(cl.data_of(i, j).newest_copy().payload)[:h, :w]
+            np.testing.assert_allclose(
+                got, np.tril(L)[i * nb:i * nb + h, j * nb:j * nb + w],
+                atol=1e-10, err_msg=f"tile {(i, j)} rank {rank}")
+
+
+def test_replicated_rhs_reads_locally():
+    """A Replicated() leaf never needs forwarding readers — consumers
+    read the local copy on every rank."""
+    n, nb, NR = 16, 4, 2
+    rng = np.random.default_rng(37)
+    G, _ = _spd(n, rng)
+    rhs = rng.standard_normal((n, 1))
+    L = np.linalg.cholesky(G @ G.T + n * np.eye(n))
+    xo = np.linalg.solve(L, rhs)
+    outs = {}
+
+    def build(rank, ctx):
+        dist = pa.Block1D(NR)
+        A = pa.from_numpy(G, nb, dist=dist, myrank=rank)
+        B = pa.from_numpy(n * np.eye(n), nb, dist=dist, myrank=rank)
+        b = pa.from_numpy(rhs, nb, 1, dist=pa.Replicated(), myrank=rank)
+        x = (A @ A.T + B).cholesky().solve(b)
+        prog = pa.lower([x], use_tpu=False)
+        # exactly ONE reader class: the A leaf feeding matmul/transpose;
+        # the replicated b and the aligned B read owner-local memory
+        readers = [c for c in prog.ptg.classes if c.startswith("arr_ld")]
+        assert len(readers) == 1, readers
+        outs[rank] = (prog, x)
+        return prog.taskpool(ctx)
+
+    run_ranks(NR, build, timeout=120)
+    # a result materialized INTO a replicated distribution lands on its
+    # canonical owner (rank 0) — the documented Replicated() contract
+    prog, x = outs[0]
+    prog.finalize()
+    xl = x._node.coll
+    for (i, j) in xl.tiles():
+        h, w = xl.tile_shape(i, j)
+        got = np.asarray(xl.data_of(i, j).newest_copy().payload)[:h, :w]
+        np.testing.assert_allclose(got, xo[i * nb:i * nb + h, :w],
+                                   atol=1e-10)

@@ -1,0 +1,79 @@
+"""DistArray.redistribute: the lazy in-graph placement change (same
+tile geometry — cross-rank movement as ordinary flow edges inside the
+fused taskpool) and the eager geometry-changing path through
+datadist.redistribute with the shared algo resolver."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu import array as pa
+
+from tests.runtime.test_multirank import run_ranks
+
+
+def test_lazy_redistribute_single_rank_is_copy():
+    rng = np.random.default_rng(3)
+    G = rng.standard_normal((20, 12))  # ragged under (8, 4)
+    A = pa.from_numpy(G, 8, 4)
+    R = A.redistribute(pa.BlockCyclic(1, 1))
+    assert not R.computed  # lazy node, same program
+    with Context(nb_cores=2) as ctx:
+        R.compute(ctx, use_tpu=False)
+    assert np.array_equal(R.to_numpy(), G)
+
+
+def test_lazy_redistribute_composes_into_one_taskpool():
+    """redistribute feeding further ops stays ONE taskpool."""
+    rng = np.random.default_rng(5)
+    G = rng.standard_normal((16, 16))
+    A = pa.from_numpy(G, 4)
+    out = A.redistribute(pa.BlockCyclic(1, 1)) + A
+    before = pa.counters()["taskpools_built"]
+    with Context(nb_cores=2) as ctx:
+        out.compute(ctx, use_tpu=False)
+    assert pa.counters()["taskpools_built"] == before + 1
+    assert np.allclose(out.to_numpy(), 2 * G)
+
+
+def test_lazy_redistribute_across_grids_2_ranks():
+    """1-D row grid -> 1-D column grid, same tiling: every moved tile
+    crosses the wire as a flow dependency inside the taskpool."""
+    NR, n, nb = 2, 24, 8
+    rng = np.random.default_rng(7)
+    G = rng.standard_normal((n, n))
+    outs = {}
+
+    def build(rank, ctx):
+        A = pa.from_numpy(G, nb, dist=pa.BlockCyclic(NR, 1), myrank=rank)
+        R = A.redistribute(pa.BlockCyclic(1, NR))
+        prog = pa.lower([R], use_tpu=False)
+        outs[rank] = (prog, R)
+        return prog.taskpool(ctx)
+
+    run_ranks(NR, build, timeout=120)
+    for rank in range(NR):
+        prog, R = outs[rank]
+        prog.finalize()
+        cl = R._node.coll
+        assert cl.rank_of(0, 1) != cl.rank_of(0, 0)  # really re-placed
+        for (i, j) in cl.local_tiles():
+            h, w = cl.tile_shape(i, j)
+            got = np.asarray(cl.data_of(i, j).newest_copy().payload)[:h, :w]
+            np.testing.assert_array_equal(
+                got, G[i * nb:i * nb + h, j * nb:j * nb + w],
+                err_msg=f"tile {(i, j)} on rank {rank}")
+
+
+def test_geometry_change_uses_datadist_path():
+    """mb/nb changes route through datadist.redistribute (the shared
+    resolver picks dtd on a single-rank mesh) and return a leaf."""
+    rng = np.random.default_rng(11)
+    G = rng.standard_normal((24, 24))
+    A = pa.from_numpy(G, 8)
+    with pytest.raises(ValueError, match="needs context"):
+        A.redistribute(pa.BlockCyclic(1, 1), mb=6, nb=6)
+    with Context(nb_cores=2) as ctx:
+        R = A.redistribute(pa.BlockCyclic(1, 1), mb=6, nb=6, context=ctx)
+    assert R.computed and (R.mb, R.nb) == (6, 6)
+    assert np.array_equal(R.to_numpy(), G)

@@ -1,0 +1,50 @@
+"""An array program is an ordinary serving-plane job: the lowered
+taskpool submits through RuntimeService as a tenant job, completes
+under co-residency, and its progress carries the tenant tag."""
+
+import numpy as np
+
+from parsec_tpu import array as pa
+from parsec_tpu.serve import RuntimeService
+
+
+def _program(seed=3, n=16, nb=4):
+    rng = np.random.default_rng(seed)
+    G = rng.standard_normal((n, n))
+    H = np.eye(n) * n
+    rhs = rng.standard_normal((n, 2))
+    A = pa.from_numpy(G, nb)
+    B = pa.from_numpy(H, nb)
+    b = pa.from_numpy(rhs, nb, 2)
+    C = (A @ A.T + B).cholesky()
+    x = C.solve(b)
+    L = np.linalg.cholesky(G @ G.T + H)
+    return pa.lower([x, C], use_tpu=False), x, np.linalg.solve(L, rhs)
+
+
+def test_array_program_submits_as_tenant_job():
+    with RuntimeService(nb_cores=2) as sv:
+        sv.tenant("arrays", weight=2)
+        prog, x, oracle = _program()
+        tp = prog.taskpool()
+        h = sv.submit("arrays", tp, priority=1)
+        assert h.wait(timeout=120)
+        prog.finalize()
+        assert tp.progress()["tenant"] == "arrays"
+        assert np.allclose(x.to_numpy(), oracle, atol=1e-10)
+
+
+def test_array_jobs_coexist_with_other_tenants():
+    """Two tenants' array programs run co-resident on one mesh and both
+    match their oracles (the multi-taskpool floor for generated
+    graphs)."""
+    with RuntimeService(nb_cores=2) as sv:
+        jobs = []
+        for i, tenant in enumerate(("acme", "globex")):
+            prog, x, oracle = _program(seed=10 + i)
+            jobs.append((sv.submit(tenant, prog.taskpool()), prog, x,
+                         oracle))
+        for h, prog, x, oracle in jobs:
+            assert h.wait(timeout=120)
+            prog.finalize()
+            assert np.allclose(x.to_numpy(), oracle, atol=1e-10)

@@ -115,6 +115,90 @@ def test_serve_status_cli_renders_tenant_table(clean_sde, capsys):
         ctx.fini()
 
 
+def test_serve_status_cli_renders_unknown_eta_as_dashes(capsys):
+    """A 0-rate window used to extrapolate a non-finite ETA and render
+    as ``inf`` — unknown (None) and non-finite ETAs must both render as
+    ``--`` (Taskpool.progress treats them as unknown too)."""
+    import http.server
+    import threading as _threading
+
+    doc = {
+        "rank": 0,
+        "serve": {
+            "closing": False, "fairness": True, "scheduler": "wdrr",
+            "limits": {"max_inflight_pools": 4, "max_ready_backlog": 0,
+                       "arena_budget": None, "max_queued": 64},
+            "jobs": {"inflight": 1, "queued": 0, "done": 0, "failed": 0,
+                     "cancelled": 0, "rejected": 0, "expired": 0},
+            "tenants": {
+                "stuck": {"weight": 1, "inflight": 1, "queued": 0,
+                          "completed": 0, "failed": 0, "rejected": 0,
+                          "retired": 0, "rate_tasks_per_s": 0.0,
+                          "eta_s": float("inf")},
+                "idle": {"weight": 1, "inflight": 0, "queued": 0,
+                         "completed": 0, "failed": 0, "rejected": 0,
+                         "retired": 0, "rate_tasks_per_s": 0.0,
+                         "eta_s": None},
+            },
+        },
+    }
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = json.dumps(doc).encode()  # inf -> "Infinity" (json)
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    t = _threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        from parsec_tpu.profiling import tools
+
+        rc = tools.main(
+            ["serve-status", f"http://127.0.0.1:{srv.server_port}"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        rows = [line for line in out.splitlines()
+                if line.strip().startswith(("stuck", "idle"))]
+        assert len(rows) == 2
+        for line in rows:
+            assert line.rstrip().endswith("--"), line
+            assert "inf" not in line
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_progress_eta_never_non_finite():
+    """Taskpool.progress() reports unknown (None), never inf/nan."""
+    import math
+
+    from parsec_tpu import Context
+    from parsec_tpu.dsl.ptg import PTG, INOUT
+    from parsec_tpu.data import LocalCollection
+
+    dc = LocalCollection("D", shape=(1,), init=lambda k: np.zeros(1))
+    ptg = PTG("quick")
+    step = ptg.task_class("step", k="0 .. 1")
+    step.affinity("D(0)")
+    step.flow("X", INOUT, "<- (k == 0) ? D(0) : X step(k-1)",
+              "-> (k < 1) ? X step(k+1) : D(0)")
+    step.body(cpu=lambda X, k: None)
+    with Context(nb_cores=1) as ctx:
+        tp = ptg.taskpool(D=dc)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=30)
+        for _ in range(3):
+            p = tp.progress()
+            assert p["eta_s"] is None or math.isfinite(p["eta_s"])
+
+
 def test_watchdog_obs008_names_stalled_tenant(clean_sde):
     """A wedged tenant job must surface as OBS008 naming the tenant —
     the 'which client is stuck' line the operator pages on."""
