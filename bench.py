@@ -1702,9 +1702,12 @@ def observability_overhead_leg(fields: dict) -> None:
     """A/B the health plane's always-on cost: tasks/s of the dpotrf
     dynamic leg (device bodies through the runtime — the production
     serving path) with nothing installed vs with the full serving
-    stack: flight recorder (bounded ring on the PINS sites), HTTP
+    stack: flight recorder (bounded ring on the PINS sites — which
+    since PR 15 also stamps job trace ids on every task token), HTTP
     exporter under a live 1 Hz scrape (Prometheus's default interval is
-    15 s; 1 Hz is already aggressive), and a stall watchdog.
+    15 s; 1 Hz is already aggressive), a stall watchdog, AND the SLO
+    plane (per-class exec-time histograms + straggler digests on the
+    EXEC pins — the per-task hot-path cost of PR 15).
     Interleaved off/on pairs so host drift hits both arms equally."""
     import threading as _th
     import urllib.request
@@ -1723,17 +1726,20 @@ def observability_overhead_leg(fields: dict) -> None:
         """One factorization to quiescence; returns tasks/s."""
         from parsec_tpu.profiling.flight import FlightRecorder
         from parsec_tpu.profiling.health import HealthServer, Watchdog
+        from parsec_tpu.profiling.slo import SloPlane
 
         ctx = Context(nb_cores=4)
-        fr = hs = wd = None
+        fr = hs = wd = slo = None
         stop_scrape = _th.Event()
         scraper = None
         try:
             if obs:
-                fr = FlightRecorder(nranks=1).install()
+                fr = FlightRecorder(nranks=1, context=ctx).install()
                 hs = HealthServer(ctx).start()
                 wd = Watchdog(ctx, window=120.0).start()
                 ctx.watchdog = wd
+                slo = SloPlane(ctx)
+                ctx.slo = slo
                 url = hs.url + "/metrics"
 
                 def scrape():
@@ -1761,6 +1767,9 @@ def observability_overhead_leg(fields: dict) -> None:
                 wd.stop()
             if hs is not None:
                 hs.stop()
+            if slo is not None:
+                slo.uninstall()
+                ctx.slo = None
             if fr is not None:
                 fr.uninstall()
             ctx.fini()
@@ -1784,6 +1793,9 @@ def observability_overhead_leg(fields: dict) -> None:
     fields["obs_tasks_per_s_on_med"] = round(on[len(on) // 2], 1)
     fields["obs_ntasks"] = ntasks
     fields["obs_overhead_frac"] = round(overhead, 4)
+    # records what the ON arm now includes (PR 15): jobtrace stamping
+    # rides the flight recorder, the SLO plane observes every exec
+    fields["obs_on_includes"] = "flight+health+watchdog+jobtrace+slo"
     if os.environ.get("PARSEC_TPU_PERF_ASSERTS", "1") != "0" \
             and overhead >= 0.03:
         raise AssertionError(

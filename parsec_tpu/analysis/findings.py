@@ -116,6 +116,18 @@ CODES = {
                       "so the operator knows WHOSE workload is wedged "
                       "(and which client to page) before reading the "
                       "protocol-level findings"),
+    "OBS009": (ERROR, "SLO violation: a tenant's observed p95 job "
+                      "latency exceeds its serve_slo_p95_ms target "
+                      "(profiling.slo histograms; the finding names the "
+                      "tenant, the measured p95 and the violating job "
+                      "count — parsec_slo_violations_total carries the "
+                      "monotone counter)"),
+    "OBS010": (WARNING, "straggler rank: a rank runs a task class "
+                        "runtime_straggler_factor times slower than the "
+                        "mesh median of per-rank means (or its "
+                        "heartbeats arrive late) — the finding names "
+                        "the rank, the class, and the in-flight jobs "
+                        "it is currently stalling"),
 }
 
 

@@ -67,7 +67,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..data.arena import ByteBudget, BytePool
-from ..profiling import pins
+from ..profiling import jobtrace, pins
 from ..utils import debug, mca_param
 from .engine import TAG_CTL
 from .payload import as_bytes, is_device_array
@@ -259,7 +259,8 @@ class _SegPull:
             pins.fire(pins.COLL_SEG, None,
                       {"rank": self.op.mgr.ce.rank, "peer": self.src,
                        "bytes": ln, "id": self.op.token,
-                       "seg": idx, "nsegs": self.nchunks})
+                       "seg": idx, "nsegs": self.nchunks,
+                       "trace": self.op.trace})
         if finish == "done":
             self.op._block_landed(self.key, self.src)
             return
@@ -288,6 +289,12 @@ class _BaseOp:
                 f"rank {self.ce.rank} is not in collective group "
                 f"{self.group}")
         self.priority = (mgr.priority if priority is None else int(priority))
+        #: job trace context (profiling.jobtrace): a collective issued
+        #: from inside a task body inherits the running job's trace id
+        #: off the worker thread (dsl.CollectiveTask's rendezvous shape),
+        #: so its spans land in the job's merged timeline; standalone
+        #: API calls carry 0
+        self.trace = jobtrace.current()
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
         self.done = False
@@ -314,7 +321,8 @@ class _BaseOp:
             pins.fire(pins.COLL_BEGIN, None,
                       {"rank": self.ce.rank, "id": self.token,
                        "kind": self.kind, "bytes": int(nbytes),
-                       "nranks": self.N, "cid": repr(self.cid)})
+                       "nranks": self.N, "cid": repr(self.cid),
+                       "trace": self.trace})
 
     def _finish(self, result) -> None:
         """Terminal success transition (any thread)."""
@@ -331,7 +339,8 @@ class _BaseOp:
             pins.fire(pins.COLL_END, None,
                       {"rank": self.ce.rank, "id": self.token,
                        "kind": self.kind, "bytes": self.total_bytes,
-                       "seconds": time.perf_counter() - self.t0})
+                       "seconds": time.perf_counter() - self.t0,
+                       "trace": self.trace})
 
     def _fail(self, why: str, notify_peers: bool = True) -> None:
         with self._lock:
@@ -350,7 +359,8 @@ class _BaseOp:
                       {"rank": self.ce.rank, "id": self.token,
                        "kind": self.kind, "bytes": self.total_bytes,
                        "failed": True,
-                       "seconds": time.perf_counter() - self.t0})
+                       "seconds": time.perf_counter() - self.t0,
+                       "trace": self.trace})
         if notify_peers:
             msg = {"op": "coll", "kind": "err", "cid": self.cid,
                    "why": why}

@@ -240,7 +240,8 @@ class _RdvPull:
             pins.fire(pins.COMM_DATA_PLD, None,
                       {"rank": self.mgr.ce.rank, "peer": self.src,
                        "bytes": ln, "kind": "rdv", "proto": "rdv",
-                       "chunk": idx, "nchunks": self.nchunks})
+                       "chunk": idx, "nchunks": self.nchunks,
+                       "trace": int(self.desc.get("trace", 0) or 0)})
         if finish == "done":
             self.mgr.stats["rdv_pulls_done"] += 1
             self.cb(from_wire(self.desc["hdr"], self.holder))
@@ -430,7 +431,8 @@ class RemoteDepManager:
         so successor lists never travel the wire."""
         targets = sorted(rank_masks.items())
         self._send_tree(tp.name, src_class, src_locals, targets,
-                        flow_payloads, priority=priority)
+                        flow_payloads, priority=priority,
+                        trace=int(getattr(tp, "trace_id", 0) or 0))
 
     def _topo_children(
             self, targets: List[Tuple[int, int]]
@@ -463,6 +465,7 @@ class RemoteDepManager:
         flow_payloads: Dict[int, np.ndarray],
         lost_mask: int = 0,
         priority: int = 0,
+        trace: int = 0,
     ) -> None:
         """Send one aggregated activation to each topology child, with its
         subtree attached as the forward set (used by the producer AND by
@@ -493,8 +496,11 @@ class RemoteDepManager:
                 payload = self._gather(payload)
             handle = (pool, src_class, src_locals, fi)
             self.ce.mem_register(handle, as_bytes(payload), uses=n)
+            # the wire-header extension: the rendezvous descriptor
+            # carries the job trace id, so every chunk the receiver
+            # lands is job-attributable (profiling.jobtrace)
             rdv_desc[fi] = {"handle": handle, "hdr": wire_header(payload),
-                            "nbytes": payload.nbytes}
+                            "nbytes": payload.nbytes, "trace": trace}
         for ((child, cmask), subtree), need in zip(children, needs):
             flows: Dict[int, dict] = {}
             for fi, payload in flow_payloads.items():
@@ -524,6 +530,8 @@ class RemoteDepManager:
             }
             if priority:
                 msg["prio"] = priority
+            if trace:
+                msg["trace"] = trace
             if lost_mask:
                 # flows lost upstream (failed GET): tell the subtree so
                 # every downstream rank fails fast instead of timing out
@@ -535,7 +543,8 @@ class RemoteDepManager:
                           {"rank": self.ce.rank, "dst": child,
                            "bytes": _wire_len(msg), "class": src_class,
                            "eager_flows": ne,
-                           "rdv_flows": len(flows) - ne})
+                           "rdv_flows": len(flows) - ne,
+                           "trace": trace})
             self.ce.send_am(TAG_ACTIVATE, child, msg, priority=priority)
 
     def send_writeback(self, tp, collection_name: str, key: Tuple,
@@ -556,6 +565,7 @@ class RemoteDepManager:
             "collection": collection_name,
             "key": tuple(key),
             "data": payload,
+            "trace": int(getattr(tp, "trace_id", 0) or 0),
         }
         self.stats["writebacks_sent"] += 1
         self.ce.send_am(TAG_ACTIVATE, dst_rank, msg)
@@ -639,7 +649,8 @@ class RemoteDepManager:
                     pins.fire(pins.COMM_DATA_PLD, None,
                               {"rank": self.ce.rank, "peer": src_rank,
                                "bytes": getattr(d["data"], "nbytes", 0),
-                               "kind": "eager", "proto": "eager"})
+                               "kind": "eager", "proto": "eager",
+                               "trace": int(msg.get("trace", 0) or 0)})
         if not pulls:
             self._complete_incoming(tp, msg, resolved, msg.get("lost", 0))
             return
@@ -698,7 +709,8 @@ class RemoteDepManager:
             self._send_tree(msg["pool"], msg["src_class"],
                             tuple(msg["src_locals"]), fwd, resolved,
                             lost_mask=failed_mask,
-                            priority=msg.get("prio", 0))
+                            priority=msg.get("prio", 0),
+                            trace=int(msg.get("trace", 0) or 0))
         tp.incoming_activation(
             src_class=msg["src_class"],
             src_locals=tuple(msg["src_locals"]),
@@ -726,7 +738,8 @@ class RemoteDepManager:
         two-regime policy as PTG activations (remote_dep_mpi.c:1319):
         small versions ride eager with the message, large ones advertise
         a chunked-rendezvous handle."""
-        msg = {"pool": tp.name, "tile": wire_key, "epoch": epoch}
+        msg = {"pool": tp.name, "tile": wire_key, "epoch": epoch,
+               "trace": int(getattr(tp, "trace_id", 0) or 0)}
         if self._regime(payload) == "eager":
             msg["kind"] = "eager"
             msg["data"] = payload
@@ -757,7 +770,8 @@ class RemoteDepManager:
             pins.fire(pins.COMM_ACTIVATE, None,
                       {"rank": self.ce.rank, "dst": dst_rank,
                        "bytes": 4 * (2 + _key_words(wire_key)),
-                       "class": "dtd"})
+                       "class": "dtd",
+                       "trace": int(getattr(tp, "trace_id", 0) or 0)})
         self.ce.send_am(TAG_DTD, dst_rank, msg)
 
     def _on_dtd(self, src_rank: int, msg: dict) -> None:
@@ -796,5 +810,6 @@ class RemoteDepManager:
                 pins.fire(pins.COMM_DATA_PLD, None,
                           {"rank": self.ce.rank, "peer": src_rank,
                            "bytes": getattr(msg["data"], "nbytes", 0),
-                           "kind": "eager", "proto": "eager"})
+                           "kind": "eager", "proto": "eager",
+                           "trace": int(msg.get("trace", 0) or 0)})
             arrived(msg["data"])

@@ -56,6 +56,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .profiling import jobtrace
 from .utils import debug, mca_param
 
 #: bump when the entry layout / fingerprint recipe changes: old entries
@@ -764,9 +765,15 @@ class ExecutableCache:
 
         t0 = time.perf_counter()
         span = pins.active(pins.COMPILE_BEGIN)
+        # job trace context (profiling.jobtrace): a compile triggered
+        # from inside a task body inherits the running job's trace id
+        # off the worker thread — the merged timeline shows WHOSE job a
+        # cold compile stalled
+        trace = jobtrace.current()
         if span:
             pins.fire(pins.COMPILE_BEGIN, None,
-                      {"rank": self.rank, "fp": fp, "key": _short(cf.key)})
+                      {"rank": self.rank, "fp": fp, "key": _short(cf.key),
+                       "trace": trace})
         kind = "miss"
         try:
             exe, kind = self._resolve_slow(cf, fp, args)
@@ -777,7 +784,7 @@ class ExecutableCache:
                 pins.fire(pins.COMPILE_END, None,
                           {"rank": self.rank, "fp": fp,
                            "key": _short(cf.key), "kind": kind,
-                           "seconds": dt})
+                           "seconds": dt, "trace": trace})
         self._lru_put(fp, exe)
         return exe
 
@@ -1014,9 +1021,14 @@ class ExecutableCache:
         if ce is None:
             return
         try:
+            # the advert names the job whose first miss triggered the
+            # compile (0 outside any job): wire-level trace context for
+            # the compile-bcast channel, mirrored into the receivers'
+            # install bookkeeping
+            trace = jobtrace.current()
             if len(blob) <= ce.eager_limit:
                 msg = {"op": _CTL_OP, "fp": fp, "meta": meta,
-                       "blob": blob}
+                       "blob": blob, "trace": trace}
                 for r in self._peers():
                     from .comm.engine import TAG_CTL
 
@@ -1029,7 +1041,7 @@ class ExecutableCache:
                 ce.mem_register(handle, np.frombuffer(blob, np.uint8),
                                 uses=len(self._peers()))
                 msg = {"op": _CTL_OP, "fp": fp, "meta": meta,
-                       "size": len(blob)}
+                       "size": len(blob), "trace": trace}
                 for r in self._peers():
                     from .comm.engine import TAG_CTL
 

@@ -18,6 +18,7 @@ import os
 import threading
 from typing import Callable, Dict, List, Optional
 
+from ..profiling import jobtrace
 from ..utils import debug, mca_param, open_component
 from . import scheduling
 from .lifecycle import HookReturn
@@ -179,7 +180,7 @@ class Context:
             from ..profiling.flight import FlightRecorder
 
             self.flight = FlightRecorder(
-                nranks=1, base_rank=self.rank).install()
+                nranks=1, base_rank=self.rank, context=self).install()
         hp = os.environ.get("PARSEC_TPU_HEALTH", "")
         if hp not in ("", "0"):
             from ..profiling.health import HealthServer
@@ -192,6 +193,16 @@ class Context:
 
             self.watchdog = Watchdog(
                 self, strict=(wd.strip().lower() == "strict")).start()
+        # PARSEC_TPU_SLO=1 — SLO plane (profiling.slo): mergeable
+        # latency histograms (per-class exec, coll segments, comm RTT,
+        # job latency/queue delay when a serving plane attaches) +
+        # straggler digests.  A RuntimeService installs one on its
+        # context by default; standalone contexts opt in here.
+        self.slo = None
+        if os.environ.get("PARSEC_TPU_SLO", "0") not in ("", "0"):
+            from ..profiling.slo import SloPlane
+
+            self.slo = SloPlane(self)
 
     # ------------------------------------------------------------------
     # taskpool lifecycle
@@ -425,6 +436,13 @@ class Context:
         finished drops it.  Single-rank (or comm-less) contexts keep the
         local fail."""
         es.stats["executed"] += 1
+        # job trace context for anything the body triggers on THIS
+        # thread (collectives, executable-cache compiles + bcasts):
+        # restore the previous value on exit so a nested
+        # help_execute_one (DTD window throttling) hands the outer
+        # task its context back
+        prev_trace = jobtrace.current()
+        jobtrace.set_current(getattr(task.taskpool, "trace_id", 0))
         try:
             scheduling.task_progress(self, es, task)
         except debug.FatalError:
@@ -455,6 +473,8 @@ class Context:
             # guards that.
             if not task.retired:
                 task.taskpool.task_done(task)
+        finally:
+            jobtrace.set_current(prev_trace)
 
     def _notify_work(self) -> None:
         with self._cv:
@@ -526,6 +546,10 @@ class Context:
         if fl is not None:
             fl.uninstall()
             self.flight = None
+        slo = getattr(self, "slo", None)
+        if slo is not None:
+            slo.uninstall()
+            self.slo = None
         for cb in getattr(self, "_fini_cbs", []):
             try:
                 cb()

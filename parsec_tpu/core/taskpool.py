@@ -14,6 +14,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, TYPE_CHECKING
 
+from ..profiling.jobtrace import trace_id_of
 from ..utils import debug, open_component
 from .task import Task, TaskClass
 from .termdet import TermDetMonitor
@@ -75,6 +76,15 @@ class Taskpool:
         self.tenant_weight: int = 1
         self.job_priority: int = 0
         self.priority_base: int = 0
+        #: 64-bit job trace id (profiling.jobtrace): derived
+        #: deterministically from the pool NAME so every rank of an
+        #: SPMD mesh computes the same id with no wire negotiation —
+        #: the same cross-rank matching contract remote activations
+        #: use.  Stamped on task spans (``job:<hex16>`` instants),
+        #: carried by activation frames / rendezvous descriptors /
+        #: collective and compile-bcast context, and sliced on by
+        #: ``tools merge`` / ``tools critpath --job``.
+        self.trace_id: int = trace_id_of(name)
         self.user: Any = None
         #: tasks retired through :meth:`task_done` (the health plane's
         #: per-taskpool progress currency); guarded — retirements arrive

@@ -52,6 +52,14 @@ PHASES = {0: "B", 1: "E", 2: "i", 3: "C"}
 _PBT_TOKEN_SEQ = itertools.count(1)
 
 
+def _sync_points_for(rank: int):
+    """Clock re-sync samples for one rank (lazy import: merge <-> binary
+    already import each other lazily in the other direction)."""
+    from .merge import sync_points_for
+
+    return sync_points_for(rank)
+
+
 class BinaryTrace:
     """Keyword dictionary + native event sink."""
 
@@ -108,11 +116,21 @@ class BinaryTrace:
             names = [None] * len(self._keywords)
             for name, kid in self._keywords.items():
                 names[kid] = name
+        meta = {"rank": self.rank, "keywords": names,
+                "streams": self._tracer.stream_names(),
+                "epoch_ns": self.epoch_ns,
+                "clock_offset_ns": self.clock_offset_ns}
+        # periodic clock re-sync samples (merge.sync_points_for): a
+        # long-lived mesh drifts past the pool-start handshake, and the
+        # merge applies a piecewise-linear correction from these
+        sync = _sync_points_for(self.rank)
+        if sync:
+            meta["clock_sync"] = sync
+        extra = getattr(self, "sidecar_extra", None)
+        if extra:
+            meta.update(extra)
         with open(path + ".meta.json", "w") as f:
-            json.dump({"rank": self.rank, "keywords": names,
-                       "streams": self._tracer.stream_names(),
-                       "epoch_ns": self.epoch_ns,
-                       "clock_offset_ns": self.clock_offset_ns}, f)
+            json.dump(meta, f)
         return n
 
     def close(self) -> None:
@@ -224,6 +242,11 @@ class RankTraceSet:
               "comm_recv_eager", "comm_recv_rdv", "frame_coalesced",
               "ce_send", "ce_recv", "qdepth", "steals", "compile",
               "coll", "coll_seg",
+              # job-level trace vocabulary (profiling.jobtrace):
+              # event_id = the 63-bit job trace id (job_map: event_id =
+              # task token, info = trace id); see TRACING.md
+              "jobwire_send", "jobwire_eager", "jobwire_rdv",
+              "jobcoll", "jobcompile", "job_phase", "job_map",
               # happens-before event kinds (analysis.hb / tools hbcheck;
               # TRACING.md "hb event kinds")
               "hb_dep_dec", "hb_ver_bump", "hb_arena_alloc",
@@ -276,6 +299,17 @@ class RankTraceSet:
                 fused_n = int(getattr(task, "fused_n", 1) or 1)
                 if fused_n > 1:
                     tr.instant(tr.keyword("fused_n"), t, fused_n)
+                # job-level tracing: one ``job_map`` instant (event_id
+                # = token, info = trace id) maps this token to its
+                # pool's job, so every span of the task is
+                # job-attributable offline (merge annotates
+                # args.trace_id; critpath --job slices on it).  ONE
+                # fixed keyword — a per-job dynamic name would grow the
+                # always-on flight recorder's keyword table without
+                # bound on a serving mesh
+                tid = int(getattr(task.taskpool, "trace_id", 0) or 0)
+                if tid:
+                    tr.instant(tr.keyword("job_map"), t, tid)
         return t
 
     # -- lifecycle -------------------------------------------------------
@@ -377,10 +411,18 @@ class RankTraceSet:
                 info = info or {}
                 tr = self._trace_of(info.get("rank", 0))
                 if tr is not None:
+                    ks = self._k[tr.rank - self.base_rank]
                     tr.instant(
-                        self._k[tr.rank - self.base_rank][key],
+                        ks[key],
                         info.get("dst", info.get("peer", 0)) or 0,
                         int(info.get("bytes", 0)))
+                    # job-attributable activation send: the wire frame
+                    # carries the pool's trace id (remote_dep), recorded
+                    # as a jobwire_send instant (event_id = trace id)
+                    trace = int(info.get("trace", 0) or 0)
+                    if trace and key == "comm_send":
+                        tr.instant(ks["jobwire_send"], trace,
+                                   int(info.get("bytes", 0)))
             return cb
 
         def pld_cb(es, info):
@@ -398,13 +440,18 @@ class RankTraceSet:
             nbytes = int(info.get("bytes", 0))
             tr.instant(ks["comm_recv"],
                        info.get("dst", info.get("peer", 0)) or 0, nbytes)
+            trace = int(info.get("trace", 0) or 0)
             if info.get("proto") == "rdv":
                 packed = ((int(info.get("chunk", 0)) << 16)
                           | (int(info.get("nchunks", 1)) & 0xFFFF))
                 tr.instant(ks["comm_recv_rdv"], packed, nbytes)
+                if trace:
+                    tr.instant(ks["jobwire_rdv"], trace, nbytes)
             else:
                 tr.instant(ks["comm_recv_eager"],
                            info.get("peer", 0) or 0, nbytes)
+                if trace:
+                    tr.instant(ks["jobwire_eager"], trace, nbytes)
 
         sub(pins.COMM_ACTIVATE, comm_cb("comm_send"))
         sub(pins.COMM_DATA_PLD, pld_cb)
@@ -460,9 +507,15 @@ class RankTraceSet:
                 if phase == "end" and str(p.get("kind", "")).startswith(
                         "hit"):
                     info = 1
-                getattr(tr, phase)(
-                    self._k[tr.rank - self.base_rank]["compile"], eid,
-                    info)
+                ks = self._k[tr.rank - self.base_rank]
+                getattr(tr, phase)(ks["compile"], eid, info)
+                # a compile stalling a JOB (trace context from the
+                # worker thread, or a compile-bcast frame): one
+                # jobcompile instant at span end (event_id = trace id,
+                # info = the span's fingerprint id for pairing)
+                trace = int(p.get("trace", 0) or 0)
+                if trace and phase == "end":
+                    tr.instant(ks["jobcompile"], trace, eid)
             return cb
 
         sub(pins.COMPILE_BEGIN, compile_cb("begin"))
@@ -480,10 +533,21 @@ class RankTraceSet:
                 p = p or {}
                 tr = self._trace_of(p.get("rank", self.base_rank))
                 if tr is not None:
+                    ks = self._k[tr.rank - self.base_rank]
                     getattr(tr, phase)(
-                        self._k[tr.rank - self.base_rank]["coll"],
+                        ks["coll"],
                         int(p.get("id", 0)) & 0x7FFFFFFFFFFFFFFF,
                         int(p.get("bytes", 0)))
+                    # job-attributable collective: the op inherited its
+                    # trace context from the issuing task's thread
+                    # (jobtrace.current at op construction) — recorded
+                    # as a jobcoll span (event_id = trace id, info =
+                    # the cid token for pairing)
+                    trace = int(p.get("trace", 0) or 0)
+                    if trace:
+                        getattr(tr, phase)(
+                            ks["jobcoll"], trace,
+                            int(p.get("id", 0)) & 0x7FFFFFFFFFFFFFFF)
             return cb
 
         sub(pins.COLL_BEGIN, coll_cb("begin"))
@@ -499,6 +563,30 @@ class RankTraceSet:
                         int(p.get("seg", 0)))
 
             sub(pins.COLL_SEG, coll_seg_cb)
+
+        # serving-plane job lifecycle (serve.RuntimeService): one
+        # ``job_phase`` instant per transition — event_id = trace id,
+        # info = phase code (jobtrace.PHASE_*).  These are what let
+        # ``tools critpath --job`` split a job's latency into
+        # queue/admit/run/drain and merge draw the phase row.
+        from .jobtrace import PHASE_ADMIT, PHASE_DONE, PHASE_SUBMIT
+
+        def job_cb(code):
+            def cb(es, p):
+                p = p or {}
+                trace = int(p.get("trace", 0) or 0)
+                if not trace:
+                    return
+                tr = self._trace_of(p.get("rank", self.base_rank))
+                if tr is None:
+                    tr = self.traces[0]
+                tr.instant(self._k[tr.rank - self.base_rank]["job_phase"],
+                           trace, code)
+            return cb
+
+        sub(pins.JOB_SUBMIT, job_cb(PHASE_SUBMIT))
+        sub(pins.JOB_ADMIT, job_cb(PHASE_ADMIT))
+        sub(pins.JOB_DONE, job_cb(PHASE_DONE))
 
         # happens-before instants (tools hbcheck reconstructs the event
         # streams offline — analysis.hb.analyze_trace).  Sites without a

@@ -107,7 +107,9 @@ def _overlap(lo: float, hi: float, merged: Sequence[Tuple[float, float]]) -> flo
 def analyze(events: List[dict], *, exec_name: str = "exec",
             comm_names: Sequence[str] = COMM_SPAN_NAMES,
             compile_names: Sequence[str] = COMPILE_SPAN_NAMES,
-            coll_names: Sequence[str] = COLL_SPAN_NAMES) -> dict:
+            coll_names: Sequence[str] = COLL_SPAN_NAMES,
+            job=None, straggler_factor: Optional[float] = None,
+            straggler_min_samples: Optional[int] = None) -> dict:
     """Reconstruct the dependency critical path and attribute its wall
     time.  Returns a report dict::
 
@@ -123,7 +125,30 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
     ``coverage`` is the attributed fraction of the chain's wall clock —
     1.0 when every pre-task gap is non-negative (async device completion
     can overlap a successor's release with its producer's span, which
-    clamps that gap to 0 and lowers coverage)."""
+    clamps that gap to 0 and lowers coverage).
+
+    ``job`` (a trace id: int, hex16 string, or ``job:<hex16>``) SLICES
+    the analysis to one job (profiling.jobtrace): only that job's tasks
+    enter the chain walk, ``per_job`` rolls chain time up by job, and a
+    ``phases`` section attributes the job's end-to-end latency across
+    queue (submit->admit), admit (admit->first task), run (first->last
+    task, itself split by the buckets) and drain (last task->done) from
+    the serve-fired ``job_phase`` instants.
+
+    A ``stragglers`` section compares per-(class, rank) mean exec time
+    against the mesh median of per-rank means over the WHOLE trace:
+    the offline counterpart of the live OBS010 finding, through the
+    SAME comparison (``profiling.slo.mesh_stragglers``) and the same
+    MCA-tuned thresholds (``runtime_straggler_factor`` /
+    ``runtime_straggler_min_samples``) unless overridden here."""
+    from .jobtrace import hex_id, job_index, parse_trace_id
+
+    job_id: Optional[int] = None
+    if job is not None:
+        job_id = parse_trace_id(job)
+    jidx = job_index(events)
+    token_to_job = jidx["token_to_job"]
+
     exec_open: Dict[Tuple[Any, Any], float] = {}
     tasks: Dict[Tuple[Any, int], dict] = {}
     classes: Dict[Tuple[Any, int], str] = {}
@@ -221,13 +246,26 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
         "tasks": int(sum(fused.values())),
         "dispatch_saved": int(sum(fused.values()) - len(fused)),
     }
+    # offline straggler attribution over the WHOLE trace (before any
+    # job slicing): per-(class, rank) mean exec vs the mesh median of
+    # per-rank means — the offline counterpart of the live OBS010
+    stragglers = _find_stragglers(tasks, classes, straggler_factor,
+                                  straggler_min_samples)
+
     empty = {"wall_us": 0.0, "n_tasks": 0, "coverage": 0.0,
              "buckets": {"compute_us": 0.0, "comm_us": 0.0,
                          "coll_us": 0.0, "compile_us": 0.0,
                          "host_gap_us": 0.0},
              "per_class": {}, "per_label": {}, "per_tenant": {},
-             "chain": [], "comm_regimes": regimes,
-             "fused": fused_summary}
+             "per_job": {}, "chain": [], "comm_regimes": regimes,
+             "fused": fused_summary, "stragglers": stragglers,
+             "job": hex_id(job_id) if job_id is not None else None,
+             "phases": None}
+    if job_id is not None:
+        # slice to ONE job: only its tasks enter the chain walk (edges
+        # restrict implicitly — the walk only follows tokens in `tasks`)
+        tasks = {k: v for k, v in tasks.items()
+                 if token_to_job.get(k) == job_id}
     if not tasks:
         return empty
     comm_merged = {pid: _merge_intervals(iv) for pid, iv in comm_iv.items()}
@@ -256,6 +294,9 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
         lambda: {"count": 0, "compute_us": 0.0, "comm_us": 0.0,
                  "coll_us": 0.0, "compile_us": 0.0, "host_gap_us": 0.0})
     per_tenant: Dict[str, Dict[str, float]] = defaultdict(
+        lambda: {"count": 0, "compute_us": 0.0, "comm_us": 0.0,
+                 "coll_us": 0.0, "compile_us": 0.0, "host_gap_us": 0.0})
+    per_job: Dict[str, Dict[str, float]] = defaultdict(
         lambda: {"count": 0, "compute_us": 0.0, "comm_us": 0.0,
                  "coll_us": 0.0, "compile_us": 0.0, "host_gap_us": 0.0})
     rows = []
@@ -301,8 +342,18 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
             pt["coll_us"] += gap_coll
             pt["compile_us"] += gap_compile
             pt["host_gap_us"] += gap - gap_comm - gap_coll - gap_compile
+        tid = token_to_job.get(key)
+        if tid is not None:
+            pj = per_job[hex_id(tid)]
+            pj["count"] += 1
+            pj["compute_us"] += dur
+            pj["comm_us"] += gap_comm
+            pj["coll_us"] += gap_coll
+            pj["compile_us"] += gap_compile
+            pj["host_gap_us"] += gap - gap_comm - gap_coll - gap_compile
         rows.append({"token": tok, "pid": pid, "class": cls,
                      "tenant": tenant,
+                     "trace_id": hex_id(tid) if tid is not None else None,
                      "begin_us": t["begin"], "end_us": t["end"],
                      "gap_us": gap, "gap_comm_us": gap_comm,
                      "gap_coll_us": gap_coll,
@@ -322,6 +373,26 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
                   "coll_us": 0.0, "compile_us": 0.0, "host_gap_us": 0.0})
         for key in agg:
             agg[key] += pc[key]
+    # job phase attribution: the serve-fired job_phase instants bound
+    # queue/admit/drain; the run window is the chain walk itself
+    phases = None
+    if job_id is not None:
+        ph = jidx["phases"].get(job_id, {})
+        first = min(t["begin"] for t in tasks.values())
+        last = max(t["end"] for t in tasks.values())
+        submit, admit = ph.get("submit_us"), ph.get("admit_us")
+        done = ph.get("done_us")
+        phases = {
+            "queue_us": max(0.0, admit - submit)
+            if submit is not None and admit is not None else None,
+            "admit_us": max(0.0, first - admit)
+            if admit is not None else None,
+            "run_us": max(0.0, last - first),
+            "drain_us": max(0.0, done - last)
+            if done is not None else None,
+            "total_us": max(0.0, done - submit)
+            if submit is not None and done is not None else None,
+        }
     return {
         "wall_us": wall,
         "n_tasks": len(chain),
@@ -330,10 +401,47 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
         "per_class": {k: dict(v) for k, v in per_class.items()},
         "per_label": per_label,
         "per_tenant": {k: dict(v) for k, v in per_tenant.items()},
+        "per_job": {k: dict(v) for k, v in per_job.items()},
         "chain": rows,
         "comm_regimes": regimes,
         "fused": fused_summary,
+        "stragglers": stragglers,
+        "job": hex_id(job_id) if job_id is not None else None,
+        "phases": phases,
     }
+
+
+def _find_stragglers(tasks: Dict[Tuple[Any, int], dict],
+                     classes: Dict[Tuple[Any, int], str],
+                     factor: Optional[float],
+                     min_samples: Optional[int]) -> List[dict]:
+    """Per-(class, rank) exec-mean outliers over the trace — the SAME
+    comparison and MCA thresholds as the live OBS010 plane
+    (``profiling.slo.mesh_stragglers``), fed trace-derived means."""
+    from .slo import mesh_stragglers, straggler_params
+
+    mca_factor, mca_min = straggler_params()
+    if factor is None:
+        factor = mca_factor
+    if min_samples is None:
+        min_samples = mca_min
+    acc: Dict[Tuple[str, Any], List[float]] = defaultdict(
+        lambda: [0, 0.0])  # (cls, pid) -> [count, sum_us]
+    for key, t in tasks.items():
+        cls = classes.get(key, "?")
+        a = acc[(cls, key[0])]
+        a[0] += 1
+        a[1] += t["end"] - t["begin"]
+    by_class: Dict[str, Dict[Any, Tuple[int, float]]] = defaultdict(dict)
+    for (cls, pid), (n, total) in acc.items():
+        if n:
+            by_class[cls][pid] = (int(n), total / n)
+    return [{"class": cls, "rank": pid,
+             "mean_us": round(mean, 1),
+             "mesh_median_us": round(med, 1),
+             "factor": round(ratio, 2)}
+            for cls, pid, mean, med, ratio in mesh_stragglers(
+                by_class, factor, min_samples)]
 
 
 def render(report: dict) -> str:
@@ -345,6 +453,17 @@ def render(report: dict) -> str:
         f"wall {wall / 1e3:.3f} ms, "
         f"coverage {report['coverage']:.1%}",
     ]
+    if report.get("job"):
+        lines[0] = f"job {report['job']} " + lines[0]
+    ph = report.get("phases")
+    if ph:
+        def _ms(v):
+            return "--" if v is None else f"{v / 1e3:.3f}"
+        lines.append(
+            f"  phases: queue {_ms(ph['queue_us'])} ms -> admit "
+            f"{_ms(ph['admit_us'])} ms -> run {_ms(ph['run_us'])} ms "
+            f"-> drain {_ms(ph['drain_us'])} ms  (total "
+            f"{_ms(ph['total_us'])} ms)")
     for k in ("compute_us", "comm_us", "coll_us", "compile_us",
               "host_gap_us"):
         frac = b.get(k, 0.0) / wall if wall > 0 else 0.0
@@ -395,4 +514,19 @@ def render(report: dict) -> str:
                 f"{pt['compute_us'] / 1e3:>12.3f}"
                 f"{pt['comm_us'] / 1e3:>10.3f}"
                 f"{pt['host_gap_us'] / 1e3:>10.3f}")
+    if report.get("per_job") and not report.get("job"):
+        lines.append(f"  {'job':<18}{'count':>6}{'compute_ms':>12}"
+                     f"{'comm_ms':>10}{'host_ms':>10}")
+        for jid in sorted(report["per_job"]):
+            pj = report["per_job"][jid]
+            lines.append(
+                f"  {jid:<18}{pj['count']:>6}"
+                f"{pj['compute_us'] / 1e3:>12.3f}"
+                f"{pj['comm_us'] / 1e3:>10.3f}"
+                f"{pj['host_gap_us'] / 1e3:>10.3f}")
+    for s in report.get("stragglers") or ():
+        lines.append(
+            f"  STRAGGLER rank {s['rank']}: class {s['class']!r} "
+            f"{s['factor']}x the mesh median ({s['mean_us'] / 1e3:.3f} ms"
+            f" vs {s['mesh_median_us'] / 1e3:.3f} ms)")
     return "\n".join(lines)

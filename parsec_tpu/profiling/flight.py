@@ -160,14 +160,22 @@ class RingTrace:
             f.write(MAGIC)
             f.write(np.int64(len(arr)).tobytes())
             f.write(arr.tobytes())
+        meta = {"rank": self.rank, "keywords": names,
+                "streams": streams, "epoch_ns": self.epoch_ns,
+                "clock_offset_ns": self.clock_offset_ns,
+                "flight_recorder": True,
+                "ring_capacity": self.capacity,
+                "events_dropped": max(0, self._logged - len(arr))}
+        from .binary import _sync_points_for
+
+        sync = _sync_points_for(self.rank)
+        if sync:
+            meta["clock_sync"] = sync
+        extra = getattr(self, "sidecar_extra", None)
+        if extra:
+            meta.update(extra)
         with open(path + ".meta.json", "w") as f:
-            json.dump({"rank": self.rank, "keywords": names,
-                       "streams": streams, "epoch_ns": self.epoch_ns,
-                       "clock_offset_ns": self.clock_offset_ns,
-                       "flight_recorder": True,
-                       "ring_capacity": self.capacity,
-                       "events_dropped": max(0, self._logged - len(arr))},
-                      f)
+            json.dump(meta, f)
         return len(arr)
 
     def close(self) -> None:
@@ -183,9 +191,14 @@ class FlightRecorder:
     exactly the event vocabulary the offline tools understand."""
 
     def __init__(self, nranks: int = 1, base_rank: int = 0,
-                 capacity: Optional[int] = None):
+                 capacity: Optional[int] = None, context=None):
         from .binary import RankTraceSet
 
+        #: owning context (set by Context.__init__ for env-installed
+        #: recorders): lets a dump snapshot the SERVING state — job
+        #: registry + tenant table — into the sidecar, so a post-mortem
+        #: names the jobs that were in flight
+        self.context = context
         if capacity is None:
             capacity = int(mca_param.register(
                 "profiling", "fr_events", 16384,
@@ -223,9 +236,32 @@ class FlightRecorder:
         self.set.set_clock_offset(rank, offset_ns)
 
     # -- dump -------------------------------------------------------------
+    def _serve_snapshot(self) -> Optional[dict]:
+        """The serving state at dump time (job registry incl. queued +
+        in-flight rows, tenant table) — None when no service is
+        attached.  Best-effort: a snapshot failure must never mask the
+        incident being dumped."""
+        ctx = self.context
+        sv = getattr(ctx, "serve", None) if ctx is not None else None
+        if sv is None:
+            return None
+        try:
+            doc = sv.status_doc()
+            return {"tenants": doc["tenants"], "jobs": doc["jobs"],
+                    "queue": doc["queue"],
+                    "jobs_inflight": doc["jobs_inflight"]}
+        except Exception as e:  # pragma: no cover - defensive
+            debug.warning("flight dump: serve snapshot failed: %s", e)
+            return None
+
     def dump(self, directory: str = ".") -> List[str]:
         """Write one ``rank<r>.fr.pbt`` (+ sidecar) per rank into
-        ``directory``; returns the paths."""
+        ``directory``; returns the paths.  When the owning context runs
+        a serving plane, the sidecar carries a ``serve`` section naming
+        the tenants and the jobs in flight at snapshot time."""
+        serve = self._serve_snapshot()
+        for tr in self.set.traces:
+            tr.sidecar_extra = {"serve": serve} if serve else None
         return self.set.dump(directory, suffix=".fr.pbt")
 
 

@@ -172,6 +172,10 @@ def context_status(ctx) -> Dict[str, Any]:
         # off ctx.serve): per-tenant jobs/retired/rate/ETA table
         "serve": (None if getattr(ctx, "serve", None) is None
                   else ctx.serve.status_doc()),
+        # SLO plane (profiling.slo): mergeable histograms, per-tenant
+        # targets/violations, straggler flags
+        "slo": (None if getattr(ctx, "slo", None) is None
+                else ctx.slo.status()),
     }
     return doc
 
@@ -304,6 +308,21 @@ def register_context_gauges(ctx) -> Callable[[], None]:
     gauge(sde.SERVE_JOBS_DONE, serve_val("done"))
     gauge(sde.SERVE_JOBS_REJECTED, serve_val("rejected"))
     gauge(sde.SERVE_TENANTS, serve_val("tenants"))
+
+    # SLO-plane counters (profiling.slo.SloPlane on ctx.slo): zero
+    # until a plane installs (PARSEC_TPU_SLO=1, or any RuntimeService)
+    def slo_violations() -> float:
+        sp = getattr(ctx, "slo", None)
+        return float(sp.violations_total()) if sp is not None else 0.0
+
+    def slo_stragglers() -> float:
+        sp = getattr(ctx, "slo", None)
+        if sp is None:
+            return 0.0
+        return float(len({s["rank"] for s in sp.stragglers()}))
+
+    gauge(sde.SLO_VIOLATIONS, slo_violations)
+    gauge(sde.SLO_STRAGGLER_RANKS, slo_stragglers)
 
     # lets context_status/prometheus_text skip this context's own gauges
     # (exported under first-class names) instead of sampling them twice
@@ -479,6 +498,14 @@ def prometheus_text(ctx) -> str:
               ar.get("classes_generated", 0))
         _line(out, "parsec_array_taskpools_total", r,
               ar.get("taskpools_built", 0))
+
+    # SLO plane: real Prometheus histogram families (_bucket/_sum/_count
+    # with cumulative le labels) + the violations counter — rendered
+    # straight off the plane's state (the /status doc carries the same
+    # numbers as JSON snapshots)
+    sp = getattr(ctx, "slo", None)
+    if sp is not None:
+        sp.prometheus_lines(doc["rank"], out)
 
     wd = doc["watchdog"]
     _line(out, "parsec_watchdog_stalled", r,
@@ -714,12 +741,39 @@ class Watchdog:
                            (_pins.EXEC_END, _on_exec_end)]
         for site, cb in self._pins_subs:
             _pins.subscribe(site, cb)
+        # periodic clock re-sync (piggybacked on the heartbeat channel):
+        # the PR-1 handshake runs once at pool start, but a serving mesh
+        # stays up for hours and drifts — every `clock_resync_interval`
+        # this rank re-estimates its offset to rank 0 (one ping/pong,
+        # midpoint method) and records the sample for merge.py's
+        # piecewise-linear correction; the latest (offset, drift-rate)
+        # pair stays readable as `clock_sync`
+        self.resync_interval = float(mca_param.register(
+            "runtime", "clock_resync_interval", 60.0,
+            help="seconds between watchdog clock re-sync ping/pongs to "
+                 "rank 0 (piggybacked on the TAG_CTL heartbeat channel; "
+                 "0 disables).  Samples feed the piecewise-linear trace "
+                 "alignment in profiling.merge"))
+        self._t_resync = float("-inf")
+        self._resync_seq = 0
+        #: latest (offset_ns, drift_ns_per_s) estimate vs rank 0
+        self.clock_sync: Optional[Dict[str, float]] = None
+        self._last_sync: Optional[tuple] = None  # (t_mono_ns, offset_ns)
         self._hb_engine = None
         ce = getattr(context, "comm", None)
         if ce is not None and getattr(ce, "nranks", 1) > 1:
             try:
                 ce.register_ctl("hb", self._on_heartbeat)
+                ce.register_ctl("clk2", self._on_resync)
                 self._hb_engine = ce
+                # a new watchdog = a new mesh for this rank (it is
+                # built at Context init, before any pool-start
+                # handshake): a previous mesh's clock-sync samples —
+                # offsets against a rank 0 that no longer exists — must
+                # not pollute this mesh's piecewise trace alignment
+                from .merge import reset_sync_points_for
+
+                reset_sync_points_for(context.rank)
             except Exception as e:  # a CTL-less test double
                 debug.warning("watchdog: heartbeat channel unavailable: "
                               "%s", e)
@@ -727,6 +781,13 @@ class Watchdog:
     # -- heartbeats -------------------------------------------------------
     def _on_heartbeat(self, src_rank: int, msg: dict) -> None:
         self.last_heard[src_rank] = time.time()
+        # straggler gossip: peers piggyback their per-class exec digest
+        # {cls: (count, mean_s)} — folded into this rank's SLO plane so
+        # every rank can compare any rank against the mesh median
+        digest = msg.get("exec")
+        slo = getattr(self.context, "slo", None)
+        if digest and slo is not None:
+            slo.note_peer_digest(src_rank, digest)
 
     def _send_heartbeats(self) -> None:
         ce = getattr(self.context, "comm", None)
@@ -735,6 +796,11 @@ class Watchdog:
         from ..comm.engine import TAG_CTL
 
         msg = {"op": "hb", "rank": ce.rank, "t": time.time()}
+        slo = getattr(self.context, "slo", None)
+        if slo is not None:
+            digest = slo.exec_digest()
+            if digest:
+                msg["exec"] = {c: [n, m] for c, (n, m) in digest.items()}
         for dst in range(ce.nranks):
             if dst == ce.rank:
                 continue
@@ -743,6 +809,72 @@ class Watchdog:
             except Exception as e:
                 debug.verbose(3, "health",
                               "heartbeat to rank %d failed: %s", dst, e)
+
+    # -- clock re-sync ----------------------------------------------------
+    def _on_resync(self, src_rank: int, msg: dict) -> None:
+        from ..comm.engine import TAG_CTL
+
+        ce = getattr(self.context, "comm", None)
+        if ce is None:
+            return
+        if msg.get("ph") == "ping":
+            # rank 0 answers with its own clock (Cristian midpoint)
+            try:
+                ce.send_am(TAG_CTL, src_rank, {
+                    "op": "clk2", "ph": "pong", "seq": msg.get("seq"),
+                    "t0": msg.get("t0"), "t_ref": time.monotonic_ns()})
+            except Exception as e:
+                debug.verbose(3, "health", "resync pong failed: %s", e)
+            return
+        if msg.get("ph") != "pong" or msg.get("seq") != self._resync_seq:
+            return
+        t1 = time.monotonic_ns()
+        t0 = int(msg["t0"])
+        rtt_ns = t1 - t0
+        offset = (t0 + t1) // 2 - int(msg["t_ref"])
+        from .merge import record_sync_point
+
+        record_sync_point(self.context.rank, t1, offset)
+        prev = self._last_sync
+        self._last_sync = (t1, offset)
+        drift = 0.0
+        if prev is not None and t1 > prev[0]:
+            drift = (offset - prev[1]) / ((t1 - prev[0]) / 1e9)
+        self.clock_sync = {"offset_ns": float(offset),
+                           "drift_ns_per_s": round(drift, 3),
+                           "rtt_ns": float(rtt_ns)}
+        slo = getattr(self.context, "slo", None)
+        if slo is not None:
+            slo.observe_rtt(rtt_ns / 1e9)
+        # the live trace sinks follow along: a flight-recorder dump cut
+        # long after pool start still aligns on the CURRENT offset
+        for attr in ("flight",):
+            fr = getattr(self.context, attr, None)
+            if fr is not None:
+                try:
+                    fr.set_clock_offset(self.context.rank, offset)
+                except Exception:
+                    pass
+
+    def _maybe_resync(self) -> None:
+        ce = getattr(self.context, "comm", None)
+        if (ce is None or getattr(ce, "nranks", 1) <= 1
+                or self.context.rank == 0 or self.resync_interval <= 0
+                or self._hb_engine is None):
+            return
+        now = time.monotonic()
+        if now - self._t_resync < self.resync_interval:
+            return
+        self._t_resync = now
+        self._resync_seq += 1
+        from ..comm.engine import TAG_CTL
+
+        try:
+            ce.send_am(TAG_CTL, 0, {"op": "clk2", "ph": "ping",
+                                    "seq": self._resync_seq,
+                                    "t0": time.monotonic_ns()})
+        except Exception as e:
+            debug.verbose(3, "health", "resync ping failed: %s", e)
 
     # -- epoch ------------------------------------------------------------
     def _active_pools(self) -> List[Any]:
@@ -808,6 +940,8 @@ class Watchdog:
             ops = getattr(ce, "_ctl_ops", None)
             if ops is not None and ops.get("hb") == self._on_heartbeat:
                 ops.pop("hb", None)
+            if ops is not None and ops.get("clk2") == self._on_resync:
+                ops.pop("clk2", None)
             self._hb_engine = None
 
     def _run(self) -> None:
@@ -819,6 +953,7 @@ class Watchdog:
 
     def _tick(self) -> None:
         self._send_heartbeats()
+        self._maybe_resync()
         epoch = self._epoch()
         now = time.monotonic()
         if epoch != self._last_epoch:
@@ -875,6 +1010,7 @@ class Watchdog:
             "last_heard_age_s": {
                 r: round(now - t, 3) for r, t in
                 sorted(dict(self.last_heard).items())},
+            "clock_sync": self.clock_sync,
             "report": self.last_report.render()
             if self.last_report is not None else None,
         }
@@ -1026,5 +1162,21 @@ class Watchdog:
                         "OBS004",
                         f"rank {peer}: last heartbeat "
                         f"{now - heard:.1f}s ago"))
+
+        # SLO plane: breached per-tenant p95 targets (OBS009) and
+        # straggling (class, rank) pairs incl. late heartbeaters
+        # (OBS010) — the serving-side "why is THIS slow" findings
+        slo = getattr(ctx, "slo", None)
+        if slo is not None:
+            try:
+                findings.extend(slo.slo_findings())
+                now = time.time()
+                ages = {r: now - t
+                        for r, t in dict(self.last_heard).items()}
+                findings.extend(slo.straggler_findings(
+                    heartbeat_ages=ages,
+                    late_after=max(2.0, 3 * self.poll)))
+            except Exception as e:  # diagnosis must never raise
+                debug.warning("slo findings failed: %s", e)
 
         return StallReport(ctx.rank, self.window, findings)

@@ -1,0 +1,179 @@
+"""Job-level distributed tracing: trace-context minting + offline index.
+
+PaRSEC's profiling layer attributes runtime behavior per *task*; since
+the serving plane (PR 9) the unit operators reason about is the **job**
+— a tenant's taskpool admitted into a shared long-lived mesh.  This
+module gives every job (and every standalone taskpool) a 64-bit *trace
+id* and defines how it travels:
+
+* **minting** — :func:`trace_id_of` derives the id deterministically
+  from the taskpool's name (blake2b, 63-bit, never 0).  Taskpools are
+  matched across ranks *by name* (the remote-dep contract), so every
+  rank of an SPMD mesh computes the SAME id for the same logical pool
+  with no wire negotiation; ``Taskpool.__init__`` stamps it as
+  ``tp.trace_id`` and ``serve.RuntimeService.submit`` records it on the
+  :class:`~parsec_tpu.serve.service.JobHandle`.
+* **task spans** — :class:`~parsec_tpu.profiling.binary.RankTraceSet`
+  emits one ``job_map`` instant per task token (event_id = token,
+  info = trace id), so every exec / complete span of the job's tasks
+  is attributable offline.
+* **the wire** — activation frames, rendezvous descriptors, DTD tile
+  shipments and write-backs carry a ``trace`` field
+  (:mod:`parsec_tpu.comm.remote_dep`); the receiving rank's comm
+  instants are recorded as ``jobwire_eager`` / ``jobwire_rdv`` /
+  ``jobwire_send`` events whose ``event_id`` IS the trace id.
+* **thread-local context** — :func:`set_current` / :func:`current`: the
+  worker loop stamps the running task's trace id before the body runs,
+  so work *initiated from inside a body* — runtime collectives
+  (:mod:`parsec_tpu.comm.coll`), executable-cache compiles and compile
+  broadcasts (:mod:`parsec_tpu.compile_cache`) — inherits the job
+  context without any API threading.
+* **job phases** — ``serve`` fires :data:`~parsec_tpu.profiling.pins.
+  JOB_SUBMIT` / ``JOB_ADMIT`` / ``JOB_DONE`` pins; traces record them as
+  ``job_phase`` instants, and ``tools critpath --job`` slices a job's
+  latency into queue / admit / compute / comm / drain.
+
+Offline, :func:`job_index` rebuilds the token -> job map and the phase
+timestamps from a (merged) Chrome trace; ``profiling.merge`` uses it to
+annotate every job-attributable event with ``args.trace_id`` and to
+append one per-job track group to the merged timeline.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Dict, List, Optional
+
+__all__ = ["trace_id_of", "hex_id", "parse_trace_id", "set_current",
+           "current", "job_index", "PHASE_SUBMIT", "PHASE_ADMIT",
+           "PHASE_DONE"]
+
+#: ``job_phase`` instant codes (``info`` field; ``event_id`` = trace id)
+PHASE_SUBMIT = 1
+PHASE_ADMIT = 2
+PHASE_DONE = 3
+
+_MASK = 0x7FFFFFFFFFFFFFFF  # trace ids fit the 63-bit trace record field
+
+
+def trace_id_of(name: str) -> int:
+    """Deterministic 63-bit trace id of a logical taskpool name (never
+    0 — 0 means "no trace context" everywhere).  ``hash()`` is seeded
+    per process; blake2b makes every rank of a multi-process mesh derive
+    the same id from the same pool name, which is the same cross-rank
+    matching contract remote activations already rely on."""
+    h = hashlib.blake2b(str(name).encode(), digest_size=8)
+    tid = int.from_bytes(h.digest(), "big") & _MASK
+    return tid or 1
+
+
+def hex_id(trace_id: int) -> str:
+    """Canonical 16-hex-digit rendering (the ``job:<hex16>`` keyword
+    suffix, the ``args.trace_id`` annotation, the ``--job`` argument)."""
+    return f"{int(trace_id) & _MASK:016x}"
+
+
+def parse_trace_id(s) -> int:
+    """Accept a hex16 string, a ``job:<hex16>`` keyword, or an int."""
+    if isinstance(s, int):
+        return s & _MASK
+    s = str(s).strip()
+    if s.startswith("job:"):
+        s = s[4:]
+    return int(s, 16) & _MASK
+
+
+# ---------------------------------------------------------------------------
+# thread-local trace context (the in-process propagation channel)
+# ---------------------------------------------------------------------------
+
+_tls = threading.local()
+
+
+def set_current(trace_id: int) -> None:
+    """Stamp the calling thread's trace context (0 = none).  The worker
+    loop calls this with the task's pool id before each body; anything
+    the body triggers on THIS thread (collectives, compiles) reads it
+    back via :func:`current`."""
+    _tls.trace = int(trace_id)
+
+
+def current() -> int:
+    """The calling thread's trace context (0 when outside any job)."""
+    return getattr(_tls, "trace", 0)
+
+
+# ---------------------------------------------------------------------------
+# offline index (shared by profiling.merge and profiling.critpath)
+# ---------------------------------------------------------------------------
+
+def job_index(events: List[dict]) -> Dict[str, Any]:
+    """Scan Chrome-trace events for the job vocabulary.  Returns::
+
+        {"token_to_job": {(pid, token): trace_id},
+         "phases": {trace_id: {"submit_us", "admit_us", "done_us"}},
+         "jobs": {trace_id, ...}}
+
+    ``job_map`` instants map task tokens to jobs (event_id = token,
+    info = trace id; the legacy per-job ``job:<hex16>`` keyword form of
+    early dumps is still read); ``job_phase`` instants carry
+    submit/admit/done timestamps (event_id = trace id, info = phase
+    code).  Multi-rank phases keep the earliest submit/admit and the
+    latest done — the mesh-wide job envelope."""
+    token_to_job: Dict[Any, int] = {}
+    phases: Dict[int, Dict[str, float]] = {}
+    jobs: set = set()
+    for e in events:
+        name = e.get("name")
+        if not isinstance(name, str):
+            continue
+        args = e.get("args", {}) or {}
+        if name == "job_map" and e.get("ph") == "i":
+            tid = int(args.get("info", 0) or 0)
+            tok = args.get("event_id")
+            if tid and tok is not None:
+                token_to_job[(e.get("pid"), tok)] = tid
+                jobs.add(tid)
+        elif name.startswith("job:") and e.get("ph") == "i":
+            try:
+                tid = parse_trace_id(name)
+            except ValueError:
+                continue
+            tok = args.get("event_id")
+            if tok is not None:
+                token_to_job[(e.get("pid"), tok)] = tid
+                jobs.add(tid)
+        elif name == "job_phase" and e.get("ph") == "i":
+            tid = int(args.get("event_id", 0) or 0)
+            if not tid:
+                continue
+            jobs.add(tid)
+            code = int(args.get("info", 0) or 0)
+            ph = phases.setdefault(tid, {})
+            ts = float(e.get("ts", 0.0))
+            if code == PHASE_SUBMIT:
+                ph["submit_us"] = min(ts, ph.get("submit_us", ts))
+            elif code == PHASE_ADMIT:
+                ph["admit_us"] = min(ts, ph.get("admit_us", ts))
+            elif code == PHASE_DONE:
+                ph["done_us"] = max(ts, ph.get("done_us", ts))
+    return {"token_to_job": token_to_job, "phases": phases, "jobs": jobs}
+
+
+def job_of_event(e: dict, token_to_job: Dict[Any, int]) -> Optional[int]:
+    """Trace id of one event, or None.  Task-lifecycle spans resolve
+    through the token map; job-vocabulary events (``jobwire_*``,
+    ``jobcoll``, ``jobcompile``, ``job_phase``) carry the id AS their
+    event_id."""
+    name = e.get("name")
+    if not isinstance(name, str):
+        return None
+    args = e.get("args", {}) or {}
+    if name in ("exec", "prepare_input", "complete_exec", "job_map"):
+        return token_to_job.get((e.get("pid"), args.get("event_id")))
+    if name.startswith(("jobwire_", "jobcoll", "jobcompile")) \
+            or name == "job_phase":
+        tid = int(args.get("event_id", 0) or 0)
+        return tid or None
+    return None

@@ -79,6 +79,16 @@ DEVICE_EPILOG_BEGIN = "device_epilog_begin"
 COLL_BEGIN = "coll_begin"
 COLL_END = "coll_end"
 COLL_SEG = "coll_seg"
+# serving-plane job lifecycle (serve.RuntimeService): fired with es=None
+# and payload {"rank", "trace", "tenant", "job_id"} at submission,
+# admission (payload additionally carries "queue_delay_s") and terminal
+# transition ("state", "latency_s").  Binary traces record them as
+# ``job_phase`` instants (event_id = trace id, info = phase code, see
+# profiling.jobtrace) — the queue/admit/run/drain envelope ``tools
+# critpath --job`` attributes a job's latency across.
+JOB_SUBMIT = "job_submit"
+JOB_ADMIT = "job_admit"
+JOB_DONE = "job_done"
 # executable-cache compile spans (compile_cache.py): one begin/end pair
 # around every cache resolution that was not an in-process hit — payload
 # {"rank","fp","key"} (+ "kind": hit_disk|hit_bcast|miss and "seconds"

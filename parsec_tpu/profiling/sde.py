@@ -61,6 +61,11 @@ FUSION_DISPATCH_SAVED = "PARSEC::FUSION::DISPATCH_SAVED"
 ARRAY_PROGRAMS_LOWERED = "PARSEC::ARRAY::PROGRAMS_LOWERED"
 ARRAY_CLASSES_GENERATED = "PARSEC::ARRAY::CLASSES_GENERATED"
 ARRAY_TASKPOOLS_BUILT = "PARSEC::ARRAY::TASKPOOLS_BUILT"
+# SLO-plane counters (profiling.slo.SloPlane — read 0 when no plane is
+# installed on the context; PARSEC_TPU_SLO=1 or a RuntimeService installs
+# one)
+SLO_VIOLATIONS = "PARSEC::SLO::VIOLATIONS"
+SLO_STRAGGLER_RANKS = "PARSEC::SLO::STRAGGLER_RANKS"
 # serving-plane counters (serve.RuntimeService.status_doc — read 0 when
 # no service is attached to the context)
 SERVE_JOBS_QUEUED = "PARSEC::SERVE::JOBS_QUEUED"

@@ -258,7 +258,8 @@ def cmd_critpath(args) -> int:
 
     doc = load(args.trace)
     report = critpath.analyze(doc.get("traceEvents", []),
-                              exec_name=args.exec_name)
+                              exec_name=args.exec_name,
+                              job=args.job or None)
     if args.json:
         print(json.dumps(report))
     else:
@@ -537,6 +538,16 @@ def cmd_serve_status(args) -> int:
     return 0
 
 
+def cmd_top(args) -> int:
+    """Live terminal dashboard over one or more /status endpoints
+    (see parsec_tpu.profiling.top; replaces one-shot serve-status for
+    operators babysitting a serving mesh)."""
+    from .top import run_top
+
+    return run_top(args.urls, interval=args.interval, once=args.once,
+                   max_updates=args.max_updates)
+
+
 def _cache_store(args):
     """(executable store, tuning store) for the CLI — both rooted in
     --dir when given, so stats/purge never mix an explicit root's
@@ -700,6 +711,11 @@ def main(argv=None) -> int:
                     help="span name of task execution (default: exec)")
     pp.add_argument("--json", action="store_true",
                     help="emit the raw report as JSON")
+    pp.add_argument("--job", default=None,
+                    help="slice to ONE job by trace id (hex16, as shown "
+                    "by tools merge / serve-status / top): only that "
+                    "job's tasks enter the chain walk, and the report "
+                    "gains a queue/admit/run/drain phase attribution")
     pp.set_defaults(fn=cmd_critpath)
     pl = sub.add_parser(
         "lint", help="ahead-of-time PTG/JDF graph verifier: edge "
@@ -752,6 +768,21 @@ def main(argv=None) -> int:
     ps.add_argument("url", help="http://host:port of a live health "
                     "endpoint whose context carries a RuntimeService")
     ps.set_defaults(fn=cmd_serve_status)
+    pt = sub.add_parser(
+        "top", help="live terminal dashboard (curses-free) over one or "
+        "more /status endpoints: tenants, in-flight jobs with phase + "
+        "ETA + trace id, per-rank straggler flags, SLO histogram "
+        "sparklines — refreshed in place")
+    pt.add_argument("urls", nargs="+",
+                    help="http://host:port of live health endpoints "
+                    "(one per rank, or just rank 0's)")
+    pt.add_argument("--interval", type=float, default=1.0,
+                    help="refresh period in seconds (default 1.0)")
+    pt.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen clear)")
+    pt.add_argument("--max-updates", type=int, default=0,
+                    help="stop after N refreshes (0 = forever)")
+    pt.set_defaults(fn=cmd_top)
     pe = sub.add_parser(
         "cache", help="persistent executable cache maintenance: list "
         "entries, stats, purge, integrity verify "

@@ -46,6 +46,7 @@ from typing import Any, Dict, List, Optional
 
 from ..core.context import Context
 from ..core.taskpool import Taskpool
+from ..profiling import pins
 from ..utils import debug, mca_param
 
 __all__ = ["AdmissionError", "JobHandle", "RuntimeService", "Tenant",
@@ -99,11 +100,17 @@ class Tenant:
 
     def __init__(self, name: str, weight: int = 1,
                  max_inflight: Optional[int] = None,
-                 max_queued: Optional[int] = None):
+                 max_queued: Optional[int] = None,
+                 slo_p95_ms: Optional[float] = None):
         self.name = str(name)
         self.weight = max(1, int(weight))
         self.max_inflight = max_inflight
         self.max_queued = max_queued
+        #: p95 job-latency SLO target in ms (None = the serve_slo_p95_ms
+        #: MCA default; 0 disables).  Evaluated continuously by the SLO
+        #: plane (profiling.slo): violating jobs count into
+        #: parsec_slo_violations_total, a breached p95 surfaces as OBS009
+        self.slo_p95_ms = slo_p95_ms
         # lifetime counters (service lock guards mutation)
         self.submitted = 0
         self.admitted = 0
@@ -138,6 +145,10 @@ class JobHandle:
         #: ``serve_arena_budget`` while the job is in flight (0 = only
         #: the live arena gauge gates)
         self.est_bytes = int(est_bytes)
+        #: 64-bit job trace id (profiling.jobtrace) — minted at submit
+        #: (derived from the pool name, so every rank of an SPMD mesh
+        #: agrees); the handle is the client-facing carrier of it
+        self.trace_id = int(getattr(taskpool, "trace_id", 0) or 0)
         self.state = QUEUED
         self.fail_reason: Optional[str] = None
         #: set by RuntimeService.cancel before the pool is failed: the
@@ -168,6 +179,8 @@ class JobHandle:
             "job_id": self.job_id,
             "tenant": self.tenant.name,
             "name": self.taskpool.name,
+            "trace_id": f"{self.trace_id:016x}" if self.trace_id
+            else None,
             "state": self.state,
             "priority": self.priority,
             "queue_delay_s": self.queue_delay_s,
@@ -281,6 +294,17 @@ class RuntimeService:
         # hang the service off the context: /status, /metrics and the
         # watchdog read per-tenant state through this backref
         context.serve = self
+        # SLO plane (profiling.slo): a serving mesh always measures —
+        # per-tenant job latency / queue-delay histograms, per-class
+        # exec digests (straggler attribution), violation counters.
+        # PARSEC_TPU_SLO=0 opts a context out explicitly.
+        import os as _os
+
+        if getattr(context, "slo", None) is None \
+                and _os.environ.get("PARSEC_TPU_SLO", "") != "0":
+            from ..profiling.slo import SloPlane
+
+            context.slo = SloPlane(context)
         # a serving mesh runs autonomously: admitted pools must progress
         # on the worker streams whether or not any client is inside a
         # JobHandle.wait (a queued client waits passively on the cv)
@@ -300,14 +324,17 @@ class RuntimeService:
     # ------------------------------------------------------------------
     def tenant(self, name: str, *, weight: Optional[int] = None,
                max_inflight: Optional[int] = None,
-               max_queued: Optional[int] = None) -> Tenant:
+               max_queued: Optional[int] = None,
+               slo_p95_ms: Optional[float] = None) -> Tenant:
         """Register (or re-tune) a tenant.  Auto-registration via
-        :meth:`submit` uses the defaults (weight 1, no quotas)."""
+        :meth:`submit` uses the defaults (weight 1, no quotas,
+        ``serve_slo_p95_ms`` SLO target)."""
         with self._lock:
             t = self.tenants.get(name)
             if t is None:
                 t = self.tenants[name] = Tenant(name, weight or 1,
-                                                max_inflight, max_queued)
+                                                max_inflight, max_queued,
+                                                slo_p95_ms)
             else:
                 if weight is not None:
                     t.weight = max(1, int(weight))
@@ -315,6 +342,8 @@ class RuntimeService:
                     t.max_inflight = max_inflight
                 if max_queued is not None:
                     t.max_queued = max_queued
+                if slo_p95_ms is not None:
+                    t.slo_p95_ms = slo_p95_ms
             return t
 
     # ------------------------------------------------------------------
@@ -372,6 +401,7 @@ class RuntimeService:
                 else None, est_bytes)
             self._queue.append(h)
             self._cv.notify_all()
+        self._fire_job_pin(pins.JOB_SUBMIT, h)
         # capacity permitting, admit THIS job synchronously (low
         # submit-to-running latency on an idle mesh) — but never do
         # other tenants' attach work on this caller's thread; older
@@ -427,6 +457,8 @@ class RuntimeService:
         h.t_admit = time.monotonic()
         t.admitted += 1
         self._inflight[h.job_id] = h
+        self._fire_job_pin(pins.JOB_ADMIT, h,
+                           queue_delay_s=h.queue_delay_s)
 
     def _pump(self, only: Optional[JobHandle] = None) -> int:
         """Admit queued jobs current capacity allows.  Reentrancy-safe
@@ -461,6 +493,7 @@ class RuntimeService:
         the submit fast path — while deadline expiry still covers
         everyone."""
         to_attach: List[JobHandle] = []
+        expired: List[JobHandle] = []
         with self._lock:
             now = time.monotonic()
             keep: List[JobHandle] = []
@@ -473,6 +506,8 @@ class RuntimeService:
                     h.tenant.failed += 1
                     self._jobs_expired += 1
                     self._jobs_failed += 1
+                    self._fire_job_pin(pins.JOB_DONE, h, state=h.state)
+                    expired.append(h)
                     continue
                 # NB: closing blocks SUBMISSION, not admission — jobs
                 # already accepted keep admitting as capacity frees, so
@@ -484,10 +519,19 @@ class RuntimeService:
                     continue
                 self._admit(h)
                 to_attach.append(h)
-            expired = len(self._queue) - len(keep) - len(to_attach)
             self._queue = keep
             if to_attach or expired:
                 self._cv.notify_all()
+        # expired jobs ARE latency outcomes: the client waited out its
+        # deadline and got a failure.  Skipping them would give the SLO
+        # histograms survivorship bias — p95 reads healthy exactly when
+        # the mesh is too overloaded to admit (client cancels stay out:
+        # an abandonment is the client's choice, not a service miss).
+        slo = getattr(self.context, "slo", None)
+        if slo is not None:
+            for h in expired:
+                slo.observe_job(h.tenant.name, h.t_done - h.t_submit,
+                                None, target_ms=h.tenant.slo_p95_ms)
         for h in to_attach:
             # attach OUTSIDE the service lock: startup enumerates and
             # schedules real tasks (reentry into _pump via on_complete
@@ -552,7 +596,24 @@ class RuntimeService:
                 self._jobs_done += 1
             self._inflight.pop(h.job_id, None)
             self._cv.notify_all()
+        self._fire_job_pin(pins.JOB_DONE, h, state=h.state,
+                           latency_s=h.latency_s)
+        slo = getattr(self.context, "slo", None)
+        if slo is not None and h.state != CANCELLED:
+            # completions AND failures are latency outcomes; a client's
+            # own cancel is an abandonment, not a service miss
+            slo.observe_job(h.tenant.name, h.latency_s, h.queue_delay_s,
+                            target_ms=h.tenant.slo_p95_ms)
         self._pump()
+
+    def _fire_job_pin(self, site: str, h: JobHandle, **extra) -> None:
+        """One job-lifecycle pin (binary traces record a ``job_phase``
+        instant — the queue/admit/run/drain envelope of ``tools
+        critpath --job``).  Near-free unless a subscriber is installed."""
+        if pins.active(site):
+            pins.fire(site, None, {
+                "rank": self.context.rank, "trace": h.trace_id,
+                "tenant": h.tenant.name, "job_id": h.job_id, **extra})
 
     def _admit_loop(self) -> None:
         """Background admitter: reacts to completions (notified) and to
@@ -591,6 +652,7 @@ class RuntimeService:
                 h.tenant.cancelled += 1
                 self._jobs_cancelled += 1
                 self._cv.notify_all()
+                self._fire_job_pin(pins.JOB_DONE, h, state=h.state)
                 return True
             if h.state != RUNNING:
                 return False
@@ -697,9 +759,11 @@ class RuntimeService:
     def status_doc(self) -> Dict[str, Any]:
         """Per-tenant serving document (the ``serve`` section of
         ``/status``; ``tools serve-status`` renders it)."""
+        slo = getattr(self.context, "slo", None)
         with self._lock:
             queue = [h.status() for h in self._queue]
             inflight = {h.job_id: h for h in self._inflight.values()}
+            running = [h.status() for h in inflight.values()]
             tenants: Dict[str, Dict[str, Any]] = {}
             for t in self.tenants.values():
                 live = [h for h in inflight.values() if h.tenant is t]
@@ -712,10 +776,19 @@ class RuntimeService:
                     rate += p["rate_tasks_per_s"]
                     if p["eta_s"] is not None:
                         eta = max(eta or 0.0, p["eta_s"])
+                slo_target = t.slo_p95_ms
+                if slo_target is None and slo is not None:
+                    slo_target = slo.default_slo_ms or None
                 tenants[t.name] = {
                     "weight": t.weight,
                     "max_inflight": t.max_inflight,
                     "max_queued": t.max_queued,
+                    "slo_p95_ms": slo_target,
+                    "p95_ms": (slo.tenant_p95_ms(t.name)
+                               if slo is not None else None),
+                    "slo_violations": (
+                        slo.violations_by_tenant().get(t.name, 0)
+                        if slo is not None else 0),
                     "submitted": t.submitted,
                     "admitted": t.admitted,
                     "completed": t.completed,
@@ -749,5 +822,9 @@ class RuntimeService:
                     "expired": self._jobs_expired,
                 },
                 "queue": queue,
+                # in-flight job rows (state/progress/ETA/trace id) — the
+                # live "what is the mesh doing right now" table `tools
+                # top` renders
+                "jobs_inflight": running,
                 "tenants": tenants,
             }

@@ -318,6 +318,7 @@ register = params.register
 get = params.get
 source = params.source
 set_param = params.set
+unset = params.unset
 load_file = params.load_file
 parse_cmdline = params.parse_cmdline
 dump = params.dump

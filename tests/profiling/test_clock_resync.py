@@ -1,0 +1,184 @@
+"""Clock re-sync for long-lived meshes: the watchdog's periodic
+re-handshake records (offset, drift) samples, and merge.py applies a
+piecewise-linear correction — pinned with SYNTHETIC drift."""
+
+import json
+import os
+import time
+
+import pytest
+
+from parsec_tpu.profiling.merge import (
+    _offset_at,
+    merge_traces,
+    record_sync_point,
+    reset_sync_points,
+    sync_points_for,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_sync():
+    reset_sync_points()
+    yield
+    reset_sync_points()
+
+
+# ---------------------------------------------------------------------------
+# estimator unit: piecewise-linear interpolation + drift extrapolation
+# ---------------------------------------------------------------------------
+
+def test_offset_interpolation_piecewise_linear():
+    pts = [(0, 0), (1_000_000_000, 1000), (2_000_000_000, 3000)]
+    assert _offset_at(pts, -5) == 0           # clamp before first
+    assert _offset_at(pts, 0) == 0
+    assert _offset_at(pts, 500_000_000) == 500
+    assert _offset_at(pts, 1_000_000_000) == 1000
+    assert _offset_at(pts, 1_500_000_000) == 2000
+    # beyond the last sample: extrapolate along the LAST segment's
+    # drift rate (a steadily drifting clock keeps drifting)
+    assert _offset_at(pts, 3_000_000_000) == 5000
+    assert _offset_at([], 123) == 0.0
+    assert _offset_at([(10, 7)], 999) == 7.0
+
+
+def test_record_sync_point_store_roundtrip():
+    record_sync_point(2, 100, 5)
+    record_sync_point(2, 50, 3)       # out of order: stored sorted
+    assert sync_points_for(2) == [(50, 3), (100, 5)]
+    assert sync_points_for(0) == []
+
+
+# ---------------------------------------------------------------------------
+# merge applies the correction: synthetic drift injection
+# ---------------------------------------------------------------------------
+
+def _write_trace(tmpdir, rank, events, epoch_ns, clock_sync=None,
+                 clock_offset_ns=0):
+    """A Chrome-JSON per-rank trace with a merge-conventions metadata
+    block (the JSON path exercises the same correction code as .pbt
+    sidecars)."""
+    meta = {"rank": rank, "epoch_ns": epoch_ns,
+            "clock_offset_ns": clock_offset_ns}
+    if clock_sync is not None:
+        meta["clock_sync"] = clock_sync
+    path = os.path.join(tmpdir, f"rank{rank}.json")
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "metadata": meta}, f)
+    return path
+
+
+def test_merge_applies_piecewise_drift_correction(tmp_path):
+    """Rank 1's clock drifts +1000 ns per µs of local time vs rank 0.
+    Two sync samples bracket the run; events that are SIMULTANEOUS in
+    true time must land on the same merged timestamp even though rank
+    1's raw timestamps run fast."""
+    d = str(tmp_path)
+    epoch = 1_000_000_000
+    # rank 0 = reference: events at 0, 1000, 2000 µs
+    r0 = [{"name": "tick", "ph": "i", "ts": float(t), "pid": 0,
+           "tid": "w", "args": {"event_id": i}}
+          for i, t in enumerate((0, 1000, 2000))]
+    # rank 1's clock runs 0.1% fast AND starts 500 µs ahead:
+    # local_ts = true_ts * 1.001 + 500 (µs).  offset(t_local) in ns:
+    # off = local_abs - true_abs
+    def local_us(true_us):
+        return true_us * 1.001 + 500.0
+
+    r1 = [{"name": "tick", "ph": "i", "ts": local_us(t), "pid": 1,
+           "tid": "w", "args": {"event_id": i}}
+          for i, t in enumerate((0, 1000, 2000))]
+    # sync samples at true times 0 and 2000 µs, expressed on rank 1's
+    # LOCAL absolute clock with the measured offset in ns
+    sync = []
+    for true_us in (0.0, 2000.0):
+        t_local_abs = epoch + local_us(true_us) * 1e3
+        off_ns = (local_us(true_us) - true_us) * 1e3
+        sync.append((int(t_local_abs), int(off_ns)))
+    p0 = _write_trace(d, 0, r0, epoch)
+    p1 = _write_trace(d, 1, r1, epoch, clock_sync=sync)
+    doc = merge_traces([p0, p1], jobs=False)
+    by = {}
+    for e in doc["traceEvents"]:
+        if e.get("name") == "tick":
+            by.setdefault(e["args"]["event_id"], {})[e["pid"]] = e["ts"]
+    for i in range(3):
+        # within 1 µs: interpolation error only (the drift is linear,
+        # so the piecewise correction is exact up to rounding)
+        assert by[i][1] == pytest.approx(by[i][0], abs=1.0), (i, by[i])
+
+
+def test_merge_without_sync_keeps_constant_offset(tmp_path):
+    """No clock_sync sidecar: the legacy single-offset path is
+    untouched (clock_offset_ns subtracted, earliest trace = t0)."""
+    d = str(tmp_path)
+    epoch = 5_000_000
+    r0 = [{"name": "tick", "ph": "i", "ts": 100.0, "pid": 0, "tid": "w",
+           "args": {}}]
+    r1 = [{"name": "tick", "ph": "i", "ts": 150.0, "pid": 1, "tid": "w",
+           "args": {}}]
+    p0 = _write_trace(d, 0, r0, epoch)
+    p1 = _write_trace(d, 1, r1, epoch, clock_offset_ns=50_000)
+    doc = merge_traces([p0, p1], jobs=False)
+    ts = {e["pid"]: e["ts"] for e in doc["traceEvents"]
+          if e.get("name") == "tick"}
+    # rank 1's 50 µs offset is taken out; its epoch base is 50 µs
+    # earlier, so t0 shifts and both land 50 µs apart minus offset
+    assert ts[1] - ts[0] == pytest.approx(0.0, abs=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# live: the watchdog's re-handshake on a 2-rank inproc pair
+# ---------------------------------------------------------------------------
+
+def test_watchdog_resync_records_samples_and_rtt():
+    from parsec_tpu import Context
+    from parsec_tpu.comm import InprocFabric
+    from parsec_tpu.profiling.health import Watchdog
+    from parsec_tpu.profiling.slo import SloPlane
+    from parsec_tpu.utils import mca_param
+
+    mca_param.set_param("runtime", "clock_resync_interval", 0.05)
+    fabric = InprocFabric(2)
+    ces = fabric.endpoints()
+    ctxs = [Context(nb_cores=1, rank=r, nranks=2, comm=ces[r])
+            for r in range(2)]
+    slos = [SloPlane(ctx) for ctx in ctxs]
+    for ctx, sp in zip(ctxs, slos):
+        ctx.slo = sp
+    wds = [Watchdog(ctx, window=3600.0, poll=0.05).start()
+           for ctx in ctxs]
+    try:
+        deadline = time.time() + 20
+        # the inproc engine is pumped by hand (no comm thread)
+        while time.time() < deadline:
+            for ce in ces:
+                ce.progress_nonblocking()
+            if len(sync_points_for(1)) >= 2 \
+                    and wds[1].clock_sync is not None:
+                break
+            time.sleep(0.001)
+        pts = sync_points_for(1)
+        assert len(pts) >= 2, "no resync samples recorded"
+        # same-process ranks share the clock, but a hand-pumped fabric
+        # has a multi-ms ping/pong rtt and the midpoint estimate's
+        # error is bounded by rtt/2 — pin the MECHANICS (samples land,
+        # bounded error), not wire-thread precision
+        assert all(abs(off) < 100_000_000 for _t, off in pts), pts
+        cs = wds[1].clock_sync
+        assert cs is not None and "drift_ns_per_s" in cs
+        assert cs["rtt_ns"] > 0
+        assert slos[1].hist("comm_rtt", ()).count >= 1
+        # rank 0 never pings itself
+        assert wds[0].clock_sync is None
+        # ...and the digest gossip rode the same heartbeats
+        st = wds[0].status()
+        assert st["clock_sync"] is None
+    finally:
+        for wd in wds:
+            wd.stop()
+        for sp in slos:
+            sp.uninstall()
+        for ctx in ctxs:
+            ctx.fini()
+        mca_param.unset("runtime", "clock_resync_interval")

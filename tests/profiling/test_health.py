@@ -183,6 +183,8 @@ def test_sde_doc_drift_after_dpotrf(clean_sde):
     # ...and the supertask-fusion gauge set (PR 12)
     assert {sde.FUSION_REGIONS_DISPATCHED, sde.FUSION_TASKS_FUSED,
             sde.FUSION_DISPATCH_SAVED} <= documented
+    # ...and the SLO-plane gauge set (PR 15)
+    assert {sde.SLO_VIOLATIONS, sde.SLO_STRAGGLER_RANKS} <= documented
 
     n, nb = 64, 16
     rng = np.random.default_rng(5)

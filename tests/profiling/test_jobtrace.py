@@ -1,0 +1,265 @@
+"""Job-level distributed tracing (profiling.jobtrace): trace-id minting,
+wire propagation (eager AND rendezvous AND collective), merged per-job
+track groups, and `critpath --job` phase attribution — the in-process
+mirror of the 2-rank loopback-TCP acceptance leg."""
+
+import itertools
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.comm import InprocFabric
+from parsec_tpu.core.taskpool import Taskpool
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl.ptg import IN, INOUT, PTG
+from parsec_tpu.profiling import critpath, jobtrace
+from parsec_tpu.profiling.binary import RankTraceSet
+from parsec_tpu.profiling.merge import merge_traces
+from parsec_tpu.serve import RuntimeService
+from parsec_tpu.utils import mca_param
+
+_uniq = itertools.count(1)
+
+
+def test_trace_id_minting_deterministic_and_nonzero():
+    a = jobtrace.trace_id_of("poolA")
+    assert a == jobtrace.trace_id_of("poolA")       # deterministic
+    assert a != jobtrace.trace_id_of("poolB")
+    assert 0 < a < (1 << 63)
+    hx = jobtrace.hex_id(a)
+    assert len(hx) == 16
+    assert jobtrace.parse_trace_id(hx) == a
+    assert jobtrace.parse_trace_id(f"job:{hx}") == a
+    assert jobtrace.parse_trace_id(a) == a
+    # every taskpool carries one, matched across ranks BY NAME
+    assert Taskpool("zzz").trace_id == jobtrace.trace_id_of("zzz")
+
+
+class _ModRankCollection(LocalCollection):
+    def rank_of(self, *key):
+        return self.data_key(*key) % self.nodes
+
+
+class _OwnRankCollection(LocalCollection):
+    def rank_of(self, *key):
+        return self.data_key(*key)
+
+
+def _job_ptg(name, nranks, coll_cid=None, ctx_ref=None):
+    """The acceptance-shaped job: a SMALL cross-rank chain (eager), a
+    BIG cross-rank chain (rendezvous at eager_limit=2048), and one
+    allreduce task per rank whose body meets inside the comm engine's
+    collective endpoint (trace context via the worker TLS)."""
+    ptg = PTG(name)
+    small = ptg.task_class("jt_small", k="0 .. N-1")
+    small.affinity("DS(k)")
+    small.flow("X", INOUT, "<- (k == 0) ? DS(0) : X jt_small(k-1)",
+               "-> (k < N-1) ? X jt_small(k+1) : DS(k)")
+    small.body(cpu=lambda X, k: X.__iadd__(1.0))
+    big = ptg.task_class("jt_big", k="0 .. N-1")
+    big.affinity("DB(k)")
+    big.flow("X", INOUT, "<- (k == 0) ? DB(0) : X jt_big(k-1)",
+             "-> (k < N-1) ? X jt_big(k+1) : DB(k)")
+    big.body(cpu=lambda X, k: X.__iadd__(1.0))
+    if coll_cid is not None:
+        ar = ptg.task_class("jt_ar", r=f"0 .. {nranks - 1}")
+        ar.affinity("DR(r)")
+        ar.flow("X", INOUT, "<- DR(r)", "-> DR(r)")
+
+        def ar_body(X, r):
+            ctx = ctx_ref[0]
+            if ctx.comm is None:
+                return
+            h = ctx.comm.coll.allreduce(
+                np.ascontiguousarray(X), cid=coll_cid)
+            assert h.wait(timeout=60), h.state()
+            X[...] = np.asarray(h.result()).reshape(X.shape)
+
+        ar.body(cpu=ar_body)
+    return ptg
+
+
+def _build_pool(ptg, nranks, rank, n, coll=False):
+    ds = _ModRankCollection("DS", shape=(n,), nodes=nranks, myrank=rank,
+                            init=lambda k: np.zeros(8))       # 64 B eager
+    db = _ModRankCollection("DB", shape=(n,), nodes=nranks, myrank=rank,
+                            init=lambda k: np.zeros(4096))    # 32 KiB rdv
+    kw = {"N": n, "DS": ds, "DB": db}
+    if coll:
+        kw["DR"] = _OwnRankCollection(
+            "DR", shape=(nranks,), nodes=nranks, myrank=rank,
+            init=lambda k: np.full(16, float(rank + 1)))
+    return ptg.taskpool(**kw)
+
+
+def test_job_trace_end_to_end_2rank_inproc():
+    """One serve job across a 2-virtual-rank mesh: the merged Perfetto
+    timeline carries the job's trace id on compute spans (both ranks),
+    eager AND rendezvous wire events, and collective spans; it contains
+    exactly ONE track group for the job; and `critpath --job` slices
+    its latency across queue/admit/run/drain."""
+    uid = next(_uniq)
+    name = f"jtpool{uid}"
+    mca_param.set_param("runtime", "comm_eager_limit", 2048)
+    traces = RankTraceSet(nranks=2).install()
+    fabric = InprocFabric(2)
+    ces = fabric.endpoints()
+    ctxs, svcs, handles = [], [], []
+    try:
+        cid = ("jt_test", uid)
+        for r in range(2):
+            ctx = Context(nb_cores=2, rank=r, nranks=2, comm=ces[r])
+            ctxs.append(ctx)
+        for r in range(2):
+            ctx_ref = [ctxs[r]]
+            ptg = _job_ptg(name, 2, coll_cid=cid, ctx_ref=ctx_ref)
+            svc = RuntimeService(context=ctxs[r], fairness=False)
+            svcs.append(svc)
+            handles.append(svc.submit(
+                "acme", _build_pool(ptg, 2, r, n=8, coll=True)))
+        # one waiter thread per rank, PLUS a dedicated pump for both
+        # inproc endpoints: the fabric has no comm thread (TCP launches
+        # do), and relying on the waiter loops alone leaves a rare
+        # window where a frame sits undelivered while every worker is
+        # blocked — the pump removes the scheduling-luck dependency
+        oks = [False, False]
+        stop_pump = threading.Event()
+
+        def _pump():
+            while not stop_pump.is_set():
+                for ce in ces:
+                    ce.progress_nonblocking()
+                time.sleep(0.001)
+
+        pump = threading.Thread(target=_pump, daemon=True)
+        pump.start()
+
+        def _wait(r):
+            oks[r] = handles[r].wait(timeout=120)
+
+        ts = [threading.Thread(target=_wait, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=150)
+        stop_pump.set()
+        pump.join(timeout=10)
+        assert all(oks), [h.status() for h in handles]
+        tid = handles[0].trace_id
+        assert tid == jobtrace.trace_id_of(name)
+        assert handles[1].trace_id == tid
+        hexid = jobtrace.hex_id(tid)
+
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            paths = traces.dump(d)
+            doc = merge_traces(paths)
+        evs = doc["traceEvents"]
+
+        # --- compute spans on BOTH ranks carry the id ---
+        for pid in (0, 1):
+            execs = [e for e in evs
+                     if e.get("name") == "exec" and e.get("pid") == pid
+                     and e.get("ph") in ("B", "E")]
+            assert execs, f"rank {pid}: no exec spans"
+            tagged = [e for e in execs
+                      if e["args"].get("trace_id") == hexid]
+            assert tagged, f"rank {pid}: no job-tagged exec spans"
+            # EVERY span of the job's tasks carries it (the only pool)
+            assert len(tagged) == len(execs)
+
+        # --- wire: eager AND rdv events with the id, on both ranks ---
+        for pid in (0, 1):
+            for kind in ("jobwire_eager", "jobwire_rdv", "jobwire_send"):
+                hits = [e for e in evs
+                        if e.get("name") == kind and e.get("pid") == pid]
+                assert hits, f"rank {pid}: no {kind} events"
+                assert all(e["args"]["trace_id"] == hexid for e in hits)
+
+        # --- collective spans with the id ---
+        coll = [e for e in evs if e.get("name") == "jobcoll"]
+        assert coll, "no jobcoll spans"
+        assert {e.get("pid") for e in coll} == {0, 1}
+        assert all(e["args"]["trace_id"] == hexid for e in coll)
+
+        # --- exactly ONE track group for the job ---
+        groups = [e for e in evs
+                  if e.get("name") == "process_name"
+                  and e.get("ph") == "M"
+                  and e["args"].get("name") == f"job {hexid}"]
+        assert len(groups) == 1
+        assert doc["metadata"]["jobs"][hexid]["ranks"] == [0, 1]
+        # the phase row rides the job track
+        phase_rows = [e for e in evs
+                      if str(e.get("name", "")).startswith("phase:")
+                      and e.get("pid") == groups[0]["pid"]]
+        assert any(e["name"] == "phase:run" for e in phase_rows)
+
+        # --- critpath --job: phases + job-only chain ---
+        rep = critpath.analyze(evs, job=hexid)
+        assert rep["job"] == hexid
+        assert rep["n_tasks"] > 0
+        ph = rep["phases"]
+        assert ph["run_us"] > 0
+        assert ph["queue_us"] is not None and ph["queue_us"] >= 0
+        assert ph["admit_us"] is not None
+        assert ph["drain_us"] is not None and ph["drain_us"] >= 0
+        assert ph["total_us"] >= ph["run_us"]
+        assert hexid in rep["per_job"]
+        rendered = critpath.render(rep)
+        assert f"job {hexid}" in rendered and "phases:" in rendered
+        # slicing to a nonexistent job yields an empty report
+        none = critpath.analyze(evs, job="0000000000000001")
+        assert none["n_tasks"] == 0
+    finally:
+        for svc in svcs:
+            svc.close(timeout=60)
+        for ctx in ctxs:
+            # caller-provided contexts are NOT fini'd by close(): tear
+            # them down so their SLO planes release the EXEC pins
+            ctx.fini()
+        traces.uninstall()
+        traces.close()
+        mca_param.unset("runtime", "comm_eager_limit")
+
+
+def test_standalone_pool_tasks_are_job_tagged():
+    """No serving plane at all: a bare taskpool still stamps its spans
+    with its name-derived trace id (merge annotates, no phase row)."""
+    traces = RankTraceSet(nranks=1).install()
+    ctx = Context(nb_cores=2)
+    try:
+        dc = LocalCollection("saD", shape=(1,), init=lambda k: np.zeros(1))
+        ptg = PTG("standalone_jt")
+        st = ptg.task_class("sa_step", k="0 .. N-1")
+        st.affinity("D(0)")
+        st.flow("X", INOUT, "<- (k == 0) ? D(0) : X sa_step(k-1)",
+                "-> (k < N-1) ? X sa_step(k+1) : D(0)")
+        st.body(cpu=lambda X, k: X.__iadd__(1.0))
+        tp = ptg.taskpool(N=4, D=dc)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=60)
+        hexid = jobtrace.hex_id(tp.trace_id)
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            doc = merge_traces(traces.dump(d))
+        evs = doc["traceEvents"]
+        tagged = [e for e in evs if e.get("name") == "exec"
+                  and e["args"].get("trace_id") == hexid]
+        assert tagged
+        assert hexid in doc["metadata"]["jobs"]
+        # phases unknown (no serve): no queue row, run row only needs
+        # exec spans — check critpath still slices
+        rep = critpath.analyze(evs, job=hexid)
+        assert rep["n_tasks"] == 4
+        assert rep["phases"]["queue_us"] is None
+        assert rep["phases"]["run_us"] > 0
+    finally:
+        traces.uninstall()
+        traces.close()
+        ctx.fini()

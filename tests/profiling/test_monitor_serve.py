@@ -1,0 +1,136 @@
+"""Satellites: the monitor's /status HTTP polling of the SERVE section
+(the PR-6 path predates PR-9's serve block — tenant/job fields must
+survive the dotted-key flattening), and the flight recorder's serve
+snapshot (post-mortems must name the jobs in flight)."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu.profiling import sde
+from parsec_tpu.profiling.monitor import poll_status, render
+from parsec_tpu.serve import RuntimeService
+
+
+@pytest.fixture
+def clean_sde():
+    sde.reset()
+    yield
+    sde.reset()
+
+
+def _gated_pool(gate, n=4, name="monpool"):
+    from parsec_tpu.data import LocalCollection
+    from parsec_tpu.dsl.ptg import INOUT, PTG
+
+    dc = LocalCollection(name + "D", shape=(1,),
+                         init=lambda k: np.zeros(1))
+    ptg = PTG(name)
+    st = ptg.task_class("mon_step", k="0 .. N-1")
+    st.affinity("D(0)")
+    st.flow("X", INOUT, "<- (k == 0) ? D(0) : X mon_step(k-1)",
+            "-> (k < N-1) ? X mon_step(k+1) : D(0)")
+
+    def body(X, k):
+        if k == 0:
+            assert gate.wait(timeout=60)
+        X += 1.0
+
+    st.body(cpu=body)
+    return ptg.taskpool(N=n, D=dc)
+
+
+def test_monitor_poll_status_flattens_serve_section(clean_sde):
+    """poll_status over a live serving mesh: tenant and job fields
+    survive the flattening with their identity in the key, and the
+    render() output names them."""
+    from parsec_tpu.profiling.health import HealthServer
+
+    svc = RuntimeService(nb_cores=2)
+    hs = HealthServer(svc.context).start()
+    gate = threading.Event()
+    try:
+        svc.tenant("t-mon", weight=3)
+        h = svc.submit("t-mon", _gated_pool(gate))
+        # mid-run sample (job wedged open on the gate)
+        sample = poll_status(hs.url)
+        assert sample["serve.tenants.t-mon.weight"] == 3
+        assert sample["serve.tenants.t-mon.inflight"] == 1
+        assert sample["serve.jobs.inflight"] == 1
+        assert sample["serve.fairness"] is True
+        # job rows keep their identity (list under jobs_inflight)
+        jobs = sample.get("serve.jobs_inflight")
+        assert isinstance(jobs, list) and jobs[0]["tenant"] == "t-mon"
+        assert jobs[0]["trace_id"] == f"{h.trace_id:016x}"
+        # render() shows the flattened keys with values
+        text = render([sample])
+        assert "serve.tenants.t-mon.weight" in text
+        gate.set()
+        assert h.wait(timeout=60)
+        done = poll_status(hs.url)
+        assert done["serve.tenants.t-mon.completed"] == 1
+        assert done["serve.jobs.done"] == 1
+        # SLO section flattens too (plane installed by the service)
+        assert any(k.startswith("slo.") for k in done)
+    finally:
+        gate.set()
+        hs.stop()
+        svc.close(timeout=30)
+
+
+def test_flight_dump_sidecar_carries_serve_snapshot(tmp_path, clean_sde):
+    """A flight-recorder snapshot cut while a serving mesh runs names
+    the tenants and the jobs in flight in its sidecar JSON."""
+    from parsec_tpu.profiling.flight import FlightRecorder
+
+    svc = RuntimeService(nb_cores=2)
+    fr = FlightRecorder(nranks=1, context=svc.context).install()
+    gate = threading.Event()
+    try:
+        svc.tenant("t-fr", weight=2)
+        h = svc.submit("t-fr", _gated_pool(gate, name="frpool"))
+        paths = fr.dump(str(tmp_path))
+        assert paths
+        with open(paths[0] + ".meta.json") as f:
+            meta = json.load(f)
+        serve = meta.get("serve")
+        assert serve, "sidecar misses the serve snapshot"
+        assert "t-fr" in serve["tenants"]
+        assert serve["tenants"]["t-fr"]["weight"] == 2
+        inflight = serve["jobs_inflight"]
+        assert len(inflight) == 1
+        assert inflight[0]["tenant"] == "t-fr"
+        assert inflight[0]["name"] == "frpool"
+        assert inflight[0]["trace_id"] == f"{h.trace_id:016x}"
+        gate.set()
+        assert h.wait(timeout=60)
+        # after the job drains, a new snapshot shows it completed
+        paths = fr.dump(str(tmp_path))
+        with open(paths[0] + ".meta.json") as f:
+            meta = json.load(f)
+        assert meta["serve"]["jobs"]["done"] == 1
+        assert meta["serve"]["jobs_inflight"] == []
+    finally:
+        gate.set()
+        fr.uninstall()
+        svc.close(timeout=30)
+
+
+def test_flight_dump_without_serve_has_no_serve_key(tmp_path):
+    """A context without a serving plane keeps the lean sidecar."""
+    from parsec_tpu import Context
+    from parsec_tpu.profiling.flight import FlightRecorder
+
+    ctx = Context(nb_cores=1)
+    fr = FlightRecorder(nranks=1, context=ctx).install()
+    try:
+        paths = fr.dump(str(tmp_path))
+        with open(paths[0] + ".meta.json") as f:
+            meta = json.load(f)
+        assert "serve" not in meta
+        assert meta["flight_recorder"] is True
+    finally:
+        fr.uninstall()
+        ctx.fini()

@@ -1,0 +1,268 @@
+"""SLO plane (profiling.slo): bit-mergeable log-bucket histograms,
+Prometheus histogram families on /metrics, OBS009 on an induced SLO
+violation, OBS010 on an induced straggler rank."""
+
+import json
+import re
+import urllib.request
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context
+from parsec_tpu.profiling import sde
+from parsec_tpu.profiling.health import HealthServer, Watchdog
+from parsec_tpu.profiling.slo import (
+    BUCKET_BOUNDS_S,
+    Histogram,
+    SloPlane,
+    merge_status_histograms,
+)
+
+
+@pytest.fixture
+def clean_sde():
+    sde.reset()
+    yield
+    sde.reset()
+
+
+# ---------------------------------------------------------------------------
+# histogram core
+# ---------------------------------------------------------------------------
+
+def test_histogram_buckets_fixed_and_le_semantics():
+    h = Histogram()
+    assert len(h.counts) == len(BUCKET_BOUNDS_S) + 1
+    h.observe(BUCKET_BOUNDS_S[0])       # == first bound -> bucket 0 (le)
+    h.observe(BUCKET_BOUNDS_S[0] * 1.5)  # -> bucket 1
+    h.observe(1e9)                       # overflow -> +Inf bucket
+    assert h.counts[0] == 1 and h.counts[1] == 1 and h.counts[-1] == 1
+    assert h.count == 3
+    # negative / NaN dropped, never poison
+    h.observe(-1.0)
+    h.observe(float("nan"))
+    assert h.count == 3
+
+
+def test_histogram_merge_is_elementwise_bucket_add():
+    """The cross-rank aggregation contract: merging rank snapshots is
+    BIT-identical to observing the union on one histogram."""
+    rng = np.random.default_rng(7)
+    samples_a = rng.uniform(1e-4, 10.0, 200)
+    samples_b = rng.uniform(1e-3, 100.0, 300)
+    ha, hb, hu = Histogram(), Histogram(), Histogram()
+    for v in samples_a:
+        ha.observe(v)
+        hu.observe(v)
+    for v in samples_b:
+        hb.observe(v)
+        hu.observe(v)
+    merged = merge_status_histograms([ha.snapshot(), hb.snapshot()])
+    assert merged.counts == hu.counts          # element-wise adds, exact
+    assert merged.count == hu.count == 500
+    assert merged.sum == pytest.approx(hu.sum)
+
+
+def test_histogram_percentile_interpolates():
+    h = Histogram()
+    for _ in range(99):
+        h.observe(0.001)
+    h.observe(10.0)
+    assert Histogram().percentile(0.5) is None
+    p50 = h.percentile(0.50)
+    assert p50 is not None and p50 <= 0.0016   # inside the 1 ms bucket
+    assert h.percentile(0.999) > 1.0           # the outlier's bucket
+
+
+def test_histogram_shape_mismatch_rejected():
+    h = Histogram()
+    with pytest.raises(ValueError):
+        h.merge_snapshot({"counts": [1, 2, 3], "sum": 0.0, "count": 6})
+
+
+# ---------------------------------------------------------------------------
+# plane: exec pins + prometheus families + findings
+# ---------------------------------------------------------------------------
+
+def _run_chain(ctx, n=6, name="slochain"):
+    from parsec_tpu.data import LocalCollection
+    from parsec_tpu.dsl.ptg import INOUT, PTG
+
+    dc = LocalCollection(name + "D", shape=(1,),
+                         init=lambda k: np.zeros(1))
+    ptg = PTG(name)
+    step = ptg.task_class("slostep", k="0 .. N-1")
+    step.affinity("D(0)")
+    step.flow("X", INOUT, "<- (k == 0) ? D(0) : X slostep(k-1)",
+              "-> (k < N-1) ? X slostep(k+1) : D(0)")
+    step.body(cpu=lambda X, k: X.__iadd__(1.0))
+    tp = ptg.taskpool(N=n, D=dc)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=60)
+    return tp
+
+
+PROM_HIST_BUCKET = re.compile(
+    r'^parsec_task_exec_seconds_bucket\{[^}]*le="([^"]+)"\} (\d+)$')
+
+
+def test_exec_histogram_exported_as_prometheus_family(clean_sde):
+    """A real run feeds per-class exec histograms; /metrics renders a
+    valid classic histogram family: cumulative _bucket series ending at
+    le="+Inf" == _count, plus _sum."""
+    ctx = Context(nb_cores=2)
+    slo = SloPlane(ctx)
+    ctx.slo = slo
+    hs = HealthServer(ctx).start()
+    try:
+        _run_chain(ctx, n=6)
+        text = urllib.request.urlopen(
+            hs.url + "/metrics", timeout=10).read().decode()
+        buckets = []
+        for ln in text.splitlines():
+            m = PROM_HIST_BUCKET.match(ln)
+            if m and 'class="slostep"' in ln:
+                buckets.append((m.group(1), int(m.group(2))))
+        assert buckets, text
+        # cumulative and monotone, +Inf last and == count
+        vals = [v for _le, v in buckets]
+        assert vals == sorted(vals)
+        assert buckets[-1][0] == "+Inf"
+        assert buckets[-1][1] == 6
+        assert re.search(
+            r'parsec_task_exec_seconds_count\{[^}]*class="slostep"\} 6',
+            text)
+        assert "parsec_task_exec_seconds_sum" in text
+        assert re.search(r'parsec_slo_violations_total\{rank="0"\} 0',
+                         text)
+        # /status carries the same numbers as JSON
+        st = json.loads(urllib.request.urlopen(
+            hs.url + "/status", timeout=10).read().decode())
+        hists = st["slo"]["histograms"]
+        key = [k for k in hists if "slostep" in k]
+        assert key and hists[key[0]]["count"] == 6
+        assert st["slo"]["bucket_bounds_s"] == list(BUCKET_BOUNDS_S)
+    finally:
+        hs.stop()
+        slo.uninstall()
+        ctx.fini()
+
+
+def test_induced_slo_violation_yields_obs009(clean_sde):
+    """A tenant with a 1 ms p95 target whose jobs take ~1 s: the
+    violation counter moves and OBS009 names the tenant."""
+    ctx = Context(nb_cores=1)
+    slo = SloPlane(ctx)
+    ctx.slo = slo
+    try:
+        for _ in range(6):
+            slo.observe_job("acme", latency_s=1.0, queue_delay_s=0.01,
+                            target_ms=1.0)
+        slo.observe_job("calm", latency_s=0.0001, queue_delay_s=0.0,
+                        target_ms=1000.0)
+        assert slo.violations_total() == 6
+        assert slo.violations_by_tenant() == {"acme": 6}
+        findings = slo.slo_findings()
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.code == "OBS009" and f.task == "acme"
+        assert "p95" in f.message and "acme" in f.message
+        assert slo.tenant_p95_ms("acme") > 1.0
+    finally:
+        slo.uninstall()
+        ctx.fini()
+
+
+def test_induced_straggler_yields_obs010_naming_rank_class(clean_sde):
+    """A peer digest 10x slower than the local mean on one class:
+    OBS010 names the rank and the class; the fast rank is not
+    flagged."""
+    ctx = Context(nb_cores=1)
+    slo = SloPlane(ctx)
+    ctx.slo = slo
+    try:
+        _run_chain(ctx, n=8)                    # local digest: fast
+        my = slo.exec_digest()["slostep"]
+        # rank 3 gossips a mean 10x the mesh median
+        slo.note_peer_digest(1, {"slostep": [my[0], my[1]]})
+        slo.note_peer_digest(3, {"slostep": [my[0], my[1] * 10.0]})
+        out = slo.stragglers()
+        assert len(out) == 1
+        s = out[0]
+        assert s["rank"] == 3 and s["class"] == "slostep"
+        assert s["factor"] >= slo.factor
+        findings = slo.straggler_findings()
+        assert any(f.code == "OBS010" and "rank 3" in f.message
+                   and "slostep" in f.message for f in findings)
+        # late heartbeats flag too
+        late = slo.straggler_findings(heartbeat_ages={2: 99.0},
+                                      late_after=5.0)
+        assert any(f.code == "OBS010" and "rank 2" in f.message
+                   and "late" in f.message for f in late)
+        # malformed gossip is dropped, never raises
+        slo.note_peer_digest(4, {"slostep": "garbage"})
+    finally:
+        slo.uninstall()
+        ctx.fini()
+
+
+def test_watchdog_report_carries_obs009_obs010(clean_sde):
+    """The diagnosis plumbs SLO + straggler findings into the
+    StallReport (on demand via diagnose())."""
+    ctx = Context(nb_cores=1)
+    slo = SloPlane(ctx)
+    ctx.slo = slo
+    wd = Watchdog(ctx, window=3600.0)   # never fires on its own
+    ctx.watchdog = wd
+    try:
+        _run_chain(ctx, n=8)
+        for _ in range(5):
+            slo.observe_job("acme", latency_s=2.0, queue_delay_s=0.0,
+                            target_ms=1.0)
+        my = slo.exec_digest()["slostep"]
+        slo.note_peer_digest(1, {"slostep": [my[0], my[1]]})
+        slo.note_peer_digest(2, {"slostep": [my[0], my[1] * 20.0]})
+        report = wd.diagnose(pools=[])
+        codes = {f.code for f in report.findings}
+        assert "OBS009" in codes and "OBS010" in codes
+        text = report.render()
+        assert "acme" in text and "rank 2" in text
+    finally:
+        wd.stop()
+        slo.uninstall()
+        ctx.fini()
+
+
+def test_serve_installs_slo_plane_and_observes_jobs(clean_sde):
+    """A RuntimeService installs the plane by default; completed jobs
+    land in the per-tenant latency histogram and status_doc carries
+    p95/violations/slo target per tenant."""
+    from parsec_tpu.serve import RuntimeService
+
+    svc = RuntimeService(nb_cores=2)
+    try:
+        ctx = svc.context
+        assert ctx.slo is not None
+        svc.tenant("t-slo", slo_p95_ms=0.0001)  # everything violates
+        from parsec_tpu.data import LocalCollection
+        from parsec_tpu.dsl.ptg import INOUT, PTG
+
+        dc = LocalCollection("svD", shape=(1,),
+                             init=lambda k: np.zeros(1))
+        ptg = PTG("svchain")
+        st = ptg.task_class("svstep", k="0 .. N-1")
+        st.affinity("D(0)")
+        st.flow("X", INOUT, "<- (k == 0) ? D(0) : X svstep(k-1)",
+                "-> (k < N-1) ? X svstep(k+1) : D(0)")
+        st.body(cpu=lambda X, k: X.__iadd__(1.0))
+        h = svc.submit("t-slo", ptg.taskpool(N=4, D=dc))
+        assert h.wait(timeout=60)
+        doc = svc.status_doc()
+        tn = doc["tenants"]["t-slo"]
+        assert tn["slo_p95_ms"] == 0.0001
+        assert tn["slo_violations"] == 1
+        assert tn["p95_ms"] is not None and tn["p95_ms"] > 0.0001
+        assert ctx.slo.violations_total() == 1
+    finally:
+        svc.close(timeout=30)

@@ -1,0 +1,148 @@
+"""`tools top` — the live curses-free dashboard over /status: rendering
+(crafted documents) and the end-to-end poll against a real serving
+mesh's health endpoint."""
+
+import io
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu.profiling import sde
+from parsec_tpu.profiling.top import (
+    fetch_status,
+    render_status,
+    run_top,
+    sparkline,
+)
+
+
+@pytest.fixture
+def clean_sde():
+    sde.reset()
+    yield
+    sde.reset()
+
+
+def test_sparkline_shapes():
+    assert sparkline([], width=8) == " " * 8
+    assert sparkline([0, 0, 0], width=4) == " " * 4
+    s = sparkline([0] * 10 + [100] + [0] * 10, width=21)
+    assert len(s) == 21
+    assert "█" in s
+    # a nonzero bucket never renders as a blank column
+    s2 = sparkline([1, 1000], width=2)
+    assert s2[0] != " " and s2[1] == "█"
+
+
+def _crafted_doc():
+    return {
+        "rank": 0, "nranks": 2,
+        "scheduler": {"name": "wdrr", "ready_tasks": 17},
+        "workers": {"executed": 4321},
+        "active_taskpools": 2,
+        "watchdog": {"stalled": False, "last_heard_age_s": {1: 0.2}},
+        "slo": {
+            "histograms": {
+                "job_latency{'tenant': 'acme'}":
+                    {"counts": [0] * 10 + [5] + [0] * 14, "sum": 1.0,
+                     "count": 5},
+            },
+            "stragglers": [{"class": "gemm", "rank": 1, "factor": 4.2,
+                            "mean_ms": 8.0, "mesh_median_ms": 1.9,
+                            "jobs": ["acme/#7"]}],
+            "violations": {"acme": 3}, "violations_total": 3,
+        },
+        "serve": {
+            "closing": False, "fairness": True, "scheduler": "wdrr",
+            "jobs": {"queued": 1, "inflight": 1, "done": 9, "failed": 0,
+                     "cancelled": 0, "rejected": 0, "expired": 0},
+            "queue": [{"job_id": 12, "tenant": "acme", "name": "qd",
+                       "state": "queued", "trace_id": "ab" * 8,
+                       "progress": None}],
+            "jobs_inflight": [{
+                "job_id": 7, "tenant": "acme", "name": "dpotrf",
+                "state": "running", "trace_id": "cd" * 8,
+                "progress": {"retired": 50, "known": 100,
+                             "eta_s": 1.25}}],
+            "tenants": {"acme": {
+                "weight": 2, "inflight": 1, "queued": 1, "completed": 9,
+                "slo_violations": 3, "p95_ms": 43.25, "slo_p95_ms": 20.0,
+                "rate_tasks_per_s": 123.4}},
+        },
+    }
+
+
+def test_render_status_crafted():
+    out = render_status([_crafted_doc()])
+    assert "2 rank(s)" not in out  # one doc = one rank listed
+    assert "ready 17" in out
+    # straggler flag names rank, class and the stalled job
+    assert "STRAGGLER" in out and "gemm" in out and "acme/#7" in out
+    # tenant table: violations + p95 vs target
+    assert "acme" in out and "43.25" in out and "20.0" in out
+    # in-flight job row: phase with percent, eta, trace id
+    assert "#   7" in out and "running 50%" in out and "1.2s" in out
+    assert "cd" * 8 in out
+    # queued job rides the same table
+    assert "ab" * 8 in out and "queued" in out
+    # histogram sparkline with the sample count
+    assert "n=5" in out and "job_latency" in out
+
+
+def test_render_status_merges_histograms_across_ranks():
+    d0, d1 = _crafted_doc(), _crafted_doc()
+    d1["rank"] = 1
+    d1["serve"] = None
+    out = render_status([d0, d1])
+    # element-wise merge doubles the count
+    assert "n=10" in out
+
+
+def test_top_once_against_live_endpoint(clean_sde):
+    """run_top --once against a real RuntimeService + HealthServer."""
+    from parsec_tpu.data import LocalCollection
+    from parsec_tpu.dsl.ptg import INOUT, PTG
+    from parsec_tpu.profiling.health import HealthServer
+    from parsec_tpu.serve import RuntimeService
+
+    svc = RuntimeService(nb_cores=2)
+    hs = HealthServer(svc.context).start()
+    gate = threading.Event()
+    try:
+        dc = LocalCollection("topD", shape=(1,),
+                             init=lambda k: np.zeros(1))
+        ptg = PTG("toppool")
+        st = ptg.task_class("top_step", k="0 .. N-1")
+        st.affinity("D(0)")
+        st.flow("X", INOUT, "<- (k == 0) ? D(0) : X top_step(k-1)",
+                "-> (k < N-1) ? X top_step(k+1) : D(0)")
+
+        def body(X, k):
+            if k == 0:
+                assert gate.wait(timeout=60)
+            X += 1.0
+
+        st.body(cpu=body)
+        h = svc.submit("t-top", ptg.taskpool(N=4, D=dc))
+        # live frame while the job is wedged open on the gate
+        buf = io.StringIO()
+        rc = run_top([hs.url], once=True, out=buf)
+        frame = buf.getvalue()
+        assert rc == 0
+        assert "parsec_tpu top" in frame
+        assert "t-top" in frame
+        assert f"{h.trace_id:016x}" in frame
+        gate.set()
+        assert h.wait(timeout=60)
+        # a dead endpoint is an error only when nothing was reachable
+        buf = io.StringIO()
+        assert run_top(["http://127.0.0.1:1/"], once=True, out=buf) == 1
+        assert "unreachable" in buf.getvalue()
+        # fetch_status appends /status itself
+        doc = fetch_status(hs.url)
+        assert doc["rank"] == 0
+    finally:
+        gate.set()
+        hs.stop()
+        svc.close(timeout=30)

@@ -637,6 +637,76 @@ def scenario_bcast(ce):
     return out
 
 
+def scenario_jobtrace(ce):
+    """Job-level trace propagation over the REAL wire (PR-15 acceptance
+    leg): one serve job on a 2-rank loopback-TCP mesh — a small
+    (eager) and a big (rendezvous) cross-rank chain plus one allreduce
+    task per rank — traced per rank, dumped to TRACE_DIR.  The parent
+    test merges the dumps and pins that every span of the job's tasks
+    on BOTH ranks carries the job's trace id (compute, eager AND rdv
+    wire events, collective spans), that the merged timeline has
+    exactly one track group for the job, and that critpath --job
+    attributes queue/admit/run/drain."""
+    from parsec_tpu.profiling.binary import RankTraceSet
+    from parsec_tpu.profiling.merge import clock_handshake
+    from parsec_tpu.serve import RuntimeService
+    from parsec_tpu.utils import mca_param
+
+    mca_param.set_param("runtime", "comm_eager_limit", 2048)
+    out_dir = os.environ["TRACE_DIR"]
+    traces = RankTraceSet(nranks=1, base_rank=ce.rank).install()
+    ctx = Context(nb_cores=2, rank=ce.rank, nranks=ce.nranks, comm=ce)
+    traces.set_clock_offset(ce.rank, clock_handshake(ce))
+
+    n = 8
+    ds = LocalCollection("DS", shape=(n,), nodes=ce.nranks,
+                         myrank=ce.rank, init=lambda k: np.zeros(8))
+    ds.rank_of = lambda *key: ds.data_key(*key) % ce.nranks
+    db = LocalCollection("DB", shape=(n,), nodes=ce.nranks,
+                         myrank=ce.rank, init=lambda k: np.zeros(4096))
+    db.rank_of = lambda *key: db.data_key(*key) % ce.nranks
+    dr = LocalCollection("DR", shape=(ce.nranks,), nodes=ce.nranks,
+                         myrank=ce.rank,
+                         init=lambda k: np.full(16, float(ce.rank + 1)))
+    dr.rank_of = lambda *key: dr.data_key(*key)
+
+    ptg = PTG("jt_tcp_job")
+    small = ptg.task_class("jt_small", k="0 .. N-1")
+    small.affinity("DS(k)")
+    small.flow("X", INOUT, "<- (k == 0) ? DS(0) : X jt_small(k-1)",
+               "-> (k < N-1) ? X jt_small(k+1) : DS(k)")
+    small.body(cpu=lambda X, k: X.__iadd__(1.0))
+    big = ptg.task_class("jt_big", k="0 .. N-1")
+    big.affinity("DB(k)")
+    big.flow("X", INOUT, "<- (k == 0) ? DB(0) : X jt_big(k-1)",
+             "-> (k < N-1) ? X jt_big(k+1) : DB(k)")
+    big.body(cpu=lambda X, k: X.__iadd__(1.0))
+    ar = ptg.task_class("jt_ar", r=f"0 .. {ce.nranks - 1}")
+    ar.affinity("DR(r)")
+    ar.flow("X", INOUT, "<- DR(r)", "-> DR(r)")
+
+    def ar_body(X, r):
+        h = ctx.comm.coll.allreduce(np.ascontiguousarray(X),
+                                    cid=("jt_tcp", 1))
+        assert h.wait(timeout=60), h.state()
+        X[...] = np.asarray(h.result()).reshape(X.shape)
+
+    ar.body(cpu=ar_body)
+
+    svc = RuntimeService(context=ctx, fairness=False)
+    ce.barrier()
+    h = svc.submit("acme", ptg.taskpool(N=n, DS=ds, DB=db, DR=dr))
+    assert h.wait(timeout=120), h.status()
+    trace_id = h.trace_id
+    ce.barrier()
+    assert svc.close(timeout=60)
+    ctx.fini()
+    paths = traces.dump(out_dir)
+    traces.uninstall()
+    traces.close()
+    return {"trace_id": f"{trace_id:016x}", "paths": paths}
+
+
 def scenario_coll(ce):
     """Runtime collectives over the REAL wire (TCP + inproc parity pin):
     ring allreduce of a chunk-training payload, reduce-scatter,

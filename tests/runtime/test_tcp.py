@@ -180,3 +180,55 @@ def test_tcp_collectives_4ranks():
     out = run_scenario("coll", 4, timeout=300)
     assert all(o["ops"] == 4 for o in out)
     assert all(o["segs"] > 0 for o in out)
+
+
+def test_tcp_jobtrace_propagation_2ranks(tmp_path):
+    """PR-15 acceptance: a job submitted through RuntimeService on a
+    2-rank loopback-TCP mesh produces a merged Perfetto timeline whose
+    compute, comm (eager AND rendezvous) and collective spans all carry
+    the job's trace id on BOTH ranks; the merged document contains
+    exactly ONE track group for the job; and `tools critpath --job`
+    attributes its latency across queue/admit/run/drain."""
+    import os
+
+    from parsec_tpu.profiling import critpath
+    from parsec_tpu.profiling.merge import merge_traces
+
+    out = run_scenario("jobtrace", 2, timeout=300,
+                       extra_env={"TRACE_DIR": str(tmp_path)})
+    hexid = out[0]["trace_id"]
+    assert all(o["trace_id"] == hexid for o in out)  # SPMD-consistent
+    paths = sorted(os.path.join(str(tmp_path), f"rank{r}.pbt")
+                   for r in range(2))
+    assert all(os.path.exists(p) for p in paths), paths
+    doc = merge_traces(paths)
+    evs = doc["traceEvents"]
+
+    for pid in (0, 1):
+        execs = [e for e in evs if e.get("name") == "exec"
+                 and e.get("pid") == pid and e.get("ph") in ("B", "E")]
+        assert execs, f"rank {pid}: no exec spans"
+        # EVERY span of the job's tasks carries the id (one job only)
+        assert all(e["args"].get("trace_id") == hexid for e in execs)
+        for kind in ("jobwire_eager", "jobwire_rdv", "jobwire_send"):
+            hits = [e for e in evs if e.get("name") == kind
+                    and e.get("pid") == pid]
+            assert hits, f"rank {pid}: no {kind} events"
+            assert all(e["args"]["trace_id"] == hexid for e in hits)
+    coll = [e for e in evs if e.get("name") == "jobcoll"]
+    assert {e.get("pid") for e in coll} == {0, 1}
+    assert all(e["args"]["trace_id"] == hexid for e in coll)
+
+    groups = [e for e in evs if e.get("name") == "process_name"
+              and e.get("ph") == "M"
+              and e["args"].get("name") == f"job {hexid}"]
+    assert len(groups) == 1, "expected exactly one job track group"
+    assert doc["metadata"]["jobs"][hexid]["ranks"] == [0, 1]
+
+    rep = critpath.analyze(evs, job=hexid)
+    assert rep["n_tasks"] > 0 and rep["job"] == hexid
+    ph = rep["phases"]
+    assert ph["run_us"] > 0
+    for key in ("queue_us", "admit_us", "drain_us", "total_us"):
+        assert ph[key] is not None and ph[key] >= 0, (key, ph)
+    assert ph["total_us"] >= ph["run_us"]

@@ -324,3 +324,20 @@ def test_operations_doc_names_serve_rows():
         "OPERATIONS.md misses serve SDE rows"
     assert "serve-status" in text, \
         "OPERATIONS.md misses the serve-status tool"
+    # PR 15: SLO plane + job tracing rows
+    assert "serve_slo_p95_ms" in text, \
+        "OPERATIONS.md misses the serve_slo_p95_ms MCA row"
+    for metric in ("parsec_job_latency_seconds",
+                   "parsec_job_queue_delay_seconds",
+                   "parsec_task_exec_seconds",
+                   "parsec_comm_rtt_seconds",
+                   "parsec_coll_segment_seconds",
+                   "parsec_slo_violations_total",
+                   "parsec_straggler_ranks"):
+        assert metric in text, f"OPERATIONS.md misses metric {metric}"
+    for code in ("OBS009", "OBS010"):
+        assert code in text, f"OPERATIONS.md misses the {code} row"
+    for param in ("runtime_clock_resync_interval",
+                  "runtime_straggler_factor"):
+        assert param in text, f"OPERATIONS.md misses MCA row {param}"
+    assert "tools top" in text, "OPERATIONS.md misses the top tool"
