@@ -39,12 +39,14 @@
 #include <mutex>
 #include <queue>
 #include <thread>
+#include <tuple>
 #include <vector>
 
 namespace {
 
 struct Task {
     int32_t priority = 0;
+    int32_t tenant = 0;  // wdrr bin index (pz_graph_task_tenant)
     int64_t user_tag = 0;
     std::atomic<int32_t> missing{0};  // unresolved predecessors
     std::vector<int64_t> succs;
@@ -71,6 +73,154 @@ struct alignas(64) WorkerQ {
     std::mutex mu;
     std::priority_queue<Ready> heap;
 };
+
+// ---- pump scheduler ------------------------------------------------------
+//
+// The ready-queue state behind the zero-interpreter lifecycle
+// (pz_graph_pop_batch / pz_graph_done_batch) and the standalone pz_rq_*
+// mirror the Python schedulers hand their queue state to.  Three pop
+// disciplines, each a faithful port of its Python counterpart so
+// determinism tests hold bit-for-bit:
+//   * prio  — (priority desc, distance asc, insertion seq asc), the spq
+//             heap key;
+//   * wdrr  — weighted deficit round robin over per-tenant bins
+//             [Shreedhar & Varghese '96], the serve plane's fairness
+//             layer (core/sched/wdrr.py): each visit replenishes
+//             quantum x weight credits, a drained bin forfeits its
+//             credits and leaves the ring, within-bin order is
+//             (priority desc, seq asc);
+//   * seeded — deterministic pop-order perturbation for the schedule
+//             explorer (sched_rnd_seed): insert at an xorshift64*-drawn
+//             position, pop from the back — any ready task may run
+//             next, reproducibly per seed.
+
+struct TenantBin {
+    int32_t weight = 1;
+    int64_t deficit = 0;
+    // (priority, -seq, id): max-heap pops (priority desc, seq asc)
+    std::priority_queue<std::tuple<int64_t, int64_t, int64_t>> heap;
+};
+
+struct SchedQ {
+    std::mutex mu;
+    int32_t policy = 0;  // 0 = prio, 1 = wdrr
+    int32_t quantum = 4;
+    int64_t seed = -1;   // >= 0 switches to seeded perturbation
+    uint64_t rng = 0;
+    int64_t seq = 0;
+    int64_t count = 0;
+    // prio mode: (priority, -distance, -seq, id)
+    std::priority_queue<std::tuple<int64_t, int64_t, int64_t, int64_t>> heap;
+    std::vector<int64_t> vec;  // seeded mode
+    std::vector<TenantBin> tenants;
+    std::vector<int32_t> ring;  // wdrr: bins with queued tasks
+    size_t cur = 0;
+
+    uint64_t next_rng() {  // xorshift64*
+        uint64_t x = rng;
+        x ^= x >> 12;
+        x ^= x << 25;
+        x ^= x >> 27;
+        rng = x;
+        return x * 0x2545F4914F6CDD1DULL;
+    }
+
+    TenantBin& bin(int32_t t) {
+        if (t < 0) t = 0;
+        if (static_cast<size_t>(t) >= tenants.size()) tenants.resize(t + 1);
+        return tenants[t];
+    }
+
+    // caller holds mu
+    void push(int64_t prio, int64_t distance, int32_t tenant, int64_t id) {
+        ++count;
+        int64_t s = seq++;
+        if (seed >= 0) {
+            size_t pos = vec.empty()
+                             ? 0
+                             : static_cast<size_t>(next_rng() % (vec.size() + 1));
+            vec.insert(vec.begin() + pos, id);
+            return;
+        }
+        if (policy == 1) {
+            if (tenant < 0) tenant = 0;
+            TenantBin& b = bin(tenant);
+            if (b.heap.empty()) ring.push_back(tenant);
+            b.heap.push({prio, -s, id});
+            return;
+        }
+        heap.push({prio, -distance, -s, id});
+    }
+
+    // caller holds mu; -1 when empty
+    int64_t pop() {
+        if (seed >= 0) {
+            if (vec.empty()) return -1;
+            int64_t id = vec.back();
+            vec.pop_back();
+            --count;
+            return id;
+        }
+        if (policy == 1) {
+            while (!ring.empty()) {
+                if (cur >= ring.size()) cur = 0;
+                TenantBin& b = tenants[ring[cur]];
+                if (b.heap.empty()) {
+                    // drained since its last pop: retire the bin and
+                    // forfeit its credits (mirror of wdrr.py select)
+                    b.deficit = 0;
+                    ring.erase(ring.begin() + cur);
+                    continue;
+                }
+                if (b.deficit <= 0)
+                    b.deficit += static_cast<int64_t>(quantum) * b.weight;
+                int64_t id = std::get<2>(b.heap.top());
+                b.heap.pop();
+                b.deficit -= 1;
+                --count;
+                if (b.deficit <= 0 || b.heap.empty()) {
+                    if (b.heap.empty()) {
+                        b.deficit = 0;
+                        ring.erase(ring.begin() + cur);
+                    } else {
+                        ++cur;
+                    }
+                }
+                return id;
+            }
+            return -1;
+        }
+        if (heap.empty()) return -1;
+        int64_t id = std::get<3>(heap.top());
+        heap.pop();
+        --count;
+        return id;
+    }
+
+    void clear() {
+        heap = {};
+        vec.clear();
+        for (TenantBin& b : tenants) {
+            b.deficit = 0;
+            b.heap = {};
+        }
+        ring.clear();
+        cur = 0;
+        count = 0;
+    }
+};
+
+// lifecycle event published to the observability drain
+// (pz_graph_events_drain): kind 0 = dep decrement (a=succ, b=ready),
+// kind 1 = ready push (a=task, b=priority), kind 2 = retire
+// (a=task, b=accepted)
+struct Evt {
+    int32_t kind;
+    int64_t a;
+    int64_t b;
+};
+
+enum EvtKind : int32_t { EVT_DEP_DEC = 0, EVT_PUBLISH = 1, EVT_RETIRE = 2 };
 
 struct Graph {
     std::vector<Task*> tasks;
@@ -100,11 +250,30 @@ struct Graph {
     std::atomic<int64_t> n_double_completes{0};
     std::atomic<bool> sealed{false};
     std::atomic<bool> failed{false};
+    //: pump mode (pz_graph_sched_config): ready pushes route into ``sq``
+    //: instead of the worker/global heaps, pops come from
+    //: pz_graph_pop_batch (or pop_ready, for worker runs that want the
+    //: wdrr/seeded disciplines), and complete() pushes every released
+    //: successor instead of keeping one (strict queue ordering)
+    std::atomic<bool> pump_on{false};
+    SchedQ sq;
+    //: lifecycle event buffer for the observability drain — recorded
+    //: only while ev_on (the Python side enables it exactly when PINS
+    //: subscribers exist), drained in batches by the control plane
+    std::atomic<bool> ev_on{false};
+    std::mutex ev_mu;
+    std::vector<Evt> events;
 
     ~Graph() {
         for (Task* t : tasks) delete t;
     }
 };
+
+void record_evt(Graph* g, int32_t kind, int64_t a, int64_t b) {
+    if (!g->ev_on.load(std::memory_order_relaxed)) return;
+    std::lock_guard<std::mutex> lk(g->ev_mu);
+    g->events.push_back({kind, a, b});
+}
 
 using BodyFn = void (*)(int64_t task_id, int64_t user_tag, void* ctx);
 // async-capable body: returns 0 (done, complete inline) or nonzero
@@ -132,8 +301,25 @@ void push_global(Graph* g, int32_t prio, int64_t id) {
     g->ready_cv.notify_one();
 }
 
+// pump-mode push: into the SchedQ disciplines, with a publish event for
+// the observability drain
+void push_pump(Graph* g, int32_t prio, int32_t tenant, int64_t id) {
+    {
+        std::lock_guard<std::mutex> lk(g->sq.mu);
+        g->sq.push(prio, 0, tenant, id);
+    }
+    record_evt(g, EVT_PUBLISH, id, prio);
+    g->push_epoch.fetch_add(1, std::memory_order_release);
+    g->ready_cv.notify_one();
+}
+
 // wid < 0: caller is not a worker (streaming inserter) — always global.
-void push_ready(Graph* g, int32_t prio, int64_t id, int32_t wid) {
+void push_ready(Graph* g, int32_t prio, int32_t tenant, int64_t id,
+                int32_t wid) {
+    if (g->pump_on.load(std::memory_order_acquire)) {
+        push_pump(g, prio, tenant, id);
+        return;
+    }
     if (wid >= 0 && g->policy.load(std::memory_order_relaxed) == POLICY_LFQ &&
         static_cast<size_t>(wid) < g->wqs.size()) {
         WorkerQ& q = g->wqs[wid];
@@ -154,6 +340,10 @@ void push_ready(Graph* g, int32_t prio, int64_t id, int32_t wid) {
 // the other workers (hierarchical order: nearest neighbour outward —
 // the reference walks its NUMA hierarchy; the ring is the 1-level form).
 int64_t pop_ready(Graph* g, int32_t wid) {
+    if (g->pump_on.load(std::memory_order_acquire)) {
+        std::lock_guard<std::mutex> lk(g->sq.mu);
+        return g->sq.pop();
+    }
     if (wid >= 0 && static_cast<size_t>(wid) < g->wqs.size()) {
         WorkerQ& q = g->wqs[wid];
         std::lock_guard<std::mutex> lk(q.mu);
@@ -214,21 +404,33 @@ int64_t complete(Graph* g, int64_t id, int32_t wid) {
         stasks.reserve(succs.size());
         for (int64_t s : succs) stasks.push_back(g->tasks[s]);
     }
+    // pump mode pushes EVERY released successor (strict queue ordering —
+    // a kept task would bypass the wdrr/seeded disciplines); worker mode
+    // keeps the best one for the es->next_task fast path
+    const bool keep_next = !g->pump_on.load(std::memory_order_acquire);
+    const bool ev = g->ev_on.load(std::memory_order_relaxed);
     int64_t keep = -1;
     int32_t keep_prio = 0;
+    int32_t keep_tenant = 0;
     for (size_t i = 0; i < succs.size(); ++i) {
         Task* st = stasks[i];
         int64_t s = succs[i];
-        if (st->missing.fetch_sub(1, std::memory_order_acq_rel) == 1) {
-            if (keep < 0) {
+        bool ready = st->missing.fetch_sub(1, std::memory_order_acq_rel) == 1;
+        if (ev) record_evt(g, EVT_DEP_DEC, s, ready ? 1 : 0);
+        if (ready) {
+            if (!keep_next) {
+                push_ready(g, st->priority, st->tenant, s, wid);
+            } else if (keep < 0) {
                 keep = s;
                 keep_prio = st->priority;
+                keep_tenant = st->tenant;
             } else if (st->priority > keep_prio) {
-                push_ready(g, keep_prio, keep, wid);
+                push_ready(g, keep_prio, keep_tenant, keep, wid);
                 keep = s;
                 keep_prio = st->priority;
+                keep_tenant = st->tenant;
             } else {
-                push_ready(g, st->priority, s, wid);
+                push_ready(g, st->priority, st->tenant, s, wid);
             }
         }
     }
@@ -332,7 +534,7 @@ void pz_graph_task_commit(void* gp, int64_t id) {
         t = g->tasks[id];
     }
     if (t->missing.fetch_sub(1, std::memory_order_acq_rel) == 1)
-        push_ready(g, t->priority, id, -1);  // inserter thread: global
+        push_ready(g, t->priority, t->tenant, id, -1);  // inserter: global
 }
 
 // Reset a QUIESCED graph for re-execution over the same structure: every
@@ -362,6 +564,14 @@ int pz_graph_reset(void* gp) {
     for (auto& q : g->wqs) {
         std::lock_guard<std::mutex> qk(q.mu);
         while (!q.heap.empty()) q.heap.pop();
+    }
+    {
+        std::lock_guard<std::mutex> sk(g->sq.mu);
+        g->sq.clear();
+    }
+    {
+        std::lock_guard<std::mutex> ek(g->ev_mu);
+        g->events.clear();
     }
     g->n_executed.store(0, std::memory_order_release);
     g->failed.store(false, std::memory_order_relaxed);
@@ -460,13 +670,15 @@ int pz_task_done(void* gp, int64_t id) {
     // either — push it globally too
     int64_t keep = complete(g, id, -1);
     if (keep >= 0) {
-        int32_t prio;
+        int32_t prio, tenant;
         {
             std::lock_guard<std::mutex> lk(g->graph_mu);
             prio = g->tasks[keep]->priority;
+            tenant = g->tasks[keep]->tenant;
         }
-        push_global(g, prio, keep);
+        push_ready(g, prio, tenant, keep, -1);
     }
+    record_evt(g, EVT_RETIRE, id, 1);
     // this may have been the LAST outstanding completion: wake sleepers
     // so the run can quiesce even when no push happened
     g->ready_cv.notify_all();
@@ -525,6 +737,185 @@ int64_t pz_graph_order(void* gp, int64_t* out, int64_t cap) {
             if (--miss[s] == 0) pq.push({g->tasks[s]->priority, -s});
     }
     return written == n ? written : -1;
+}
+
+// ---- zero-interpreter lifecycle (pump mode) ------------------------------
+//
+// The batched hot loop behind NativeExecutor's pump: the control plane
+// makes ONE call per batch in each direction (pop_batch out, done_batch
+// in) and the entire per-task lifecycle — dep-counter decrement,
+// ready-queue push/pop under the configured discipline, retire counting,
+// quiescence — runs in here without entering the interpreter.
+
+// Route ready pushes/pops through the SchedQ disciplines.  policy: 0 =
+// (priority, insertion) heap, 1 = wdrr per-tenant deficit round robin;
+// quantum: wdrr credits per visit (scaled by tenant weight; < 1 keeps
+// the default 4); seed >= 0: seeded pop-order perturbation for the
+// schedule explorer (overrides policy ordering).  Must be called BEFORE
+// tasks commit — commit-time pushes land in the configured queues.
+void pz_graph_sched_config(void* gp, int32_t policy, int32_t quantum,
+                           int64_t seed) {
+    Graph* g = static_cast<Graph*>(gp);
+    {
+        std::lock_guard<std::mutex> lk(g->sq.mu);
+        g->sq.policy = policy == 1 ? 1 : 0;
+        if (quantum >= 1) g->sq.quantum = quantum;
+        g->sq.seed = seed;
+        if (seed >= 0)
+            g->sq.rng = static_cast<uint64_t>(seed) * 0x9E3779B97F4A7C15ULL +
+                        0x2545F4914F6CDD1DULL;
+    }
+    g->pump_on.store(true, std::memory_order_release);
+}
+
+// Assign a task to a wdrr tenant bin (before its commit).
+void pz_graph_task_tenant(void* gp, int64_t id, int32_t tenant) {
+    Graph* g = static_cast<Graph*>(gp);
+    std::lock_guard<std::mutex> lk(g->graph_mu);
+    if (id < 0 || id >= static_cast<int64_t>(g->tasks.size())) return;
+    g->tasks[id]->tenant = tenant < 0 ? 0 : tenant;
+}
+
+// (Re-)tune a tenant bin's wdrr weight — weights are service-managed
+// and the latest admitted pool wins, mirroring wdrr.py.
+void pz_graph_tenant_weight(void* gp, int32_t tenant, int32_t weight) {
+    Graph* g = static_cast<Graph*>(gp);
+    std::lock_guard<std::mutex> lk(g->sq.mu);
+    g->sq.bin(tenant).weight = weight < 1 ? 1 : weight;
+}
+
+// Pop up to cap ready task ids under the configured discipline; returns
+// the count written (0 = nothing ready right now).
+int64_t pz_graph_pop_batch(void* gp, int64_t* out, int64_t cap) {
+    Graph* g = static_cast<Graph*>(gp);
+    std::lock_guard<std::mutex> lk(g->sq.mu);
+    int64_t n = 0;
+    while (n < cap) {
+        int64_t id = g->sq.pop();
+        if (id < 0) break;
+        out[n++] = id;
+    }
+    return n;
+}
+
+// Retire a batch: each task's successors are decremented and newly-ready
+// ones pushed — natively, in one call for the whole batch.  Double
+// completions are refused per task (counted, skipped).  Returns the
+// number accepted.
+int64_t pz_graph_done_batch(void* gp, const int64_t* ids, int64_t n) {
+    Graph* g = static_cast<Graph*>(gp);
+    int64_t accepted = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t id = ids[i];
+        Task* t;
+        {
+            std::lock_guard<std::mutex> lk(g->graph_mu);
+            if (id < 0 || id >= static_cast<int64_t>(g->tasks.size()))
+                continue;
+            t = g->tasks[id];
+            if (t->done.exchange(true, std::memory_order_acq_rel)) {
+                g->n_double_completes.fetch_add(1, std::memory_order_relaxed);
+                record_evt(g, EVT_RETIRE, id, 0);
+                continue;
+            }
+        }
+        complete(g, id, -1);  // pump routing: every successor is pushed
+        record_evt(g, EVT_RETIRE, id, 1);
+        ++accepted;
+    }
+    g->ready_cv.notify_all();
+    return accepted;
+}
+
+// 1 when every inserted task has retired and the graph is sealed.
+int32_t pz_graph_quiesced(void* gp) {
+    return all_done(static_cast<Graph*>(gp)) ? 1 : 0;
+}
+
+// Queued-task estimate in the pump scheduler (PAPI-SDE style counter).
+int64_t pz_graph_sched_pending(void* gp) {
+    Graph* g = static_cast<Graph*>(gp);
+    std::lock_guard<std::mutex> lk(g->sq.mu);
+    return g->sq.count;
+}
+
+// Enable/disable lifecycle event recording.  The control plane flips
+// this on exactly when PINS subscribers exist — recording is a relaxed
+// load on the hot path when off.
+void pz_graph_events_enable(void* gp, int32_t on) {
+    static_cast<Graph*>(gp)->ev_on.store(on != 0, std::memory_order_relaxed);
+}
+
+// Drain up to cap buffered lifecycle events into the parallel arrays
+// (kind, a, b) — see EvtKind; returns the count drained.  The Python
+// side republishes them through PINS (DEP_DECREMENT / SCHEDULE /
+// NATIVE_TASK_DONE) so hb-check, critpath and the binary traces keep
+// seeing native-scheduled runs.
+int64_t pz_graph_events_drain(void* gp, int32_t* kinds, int64_t* a,
+                              int64_t* b, int64_t cap) {
+    Graph* g = static_cast<Graph*>(gp);
+    std::lock_guard<std::mutex> lk(g->ev_mu);
+    int64_t n = static_cast<int64_t>(g->events.size());
+    if (n > cap) n = cap;
+    for (int64_t i = 0; i < n; ++i) {
+        kinds[i] = g->events[i].kind;
+        a[i] = g->events[i].a;
+        b[i] = g->events[i].b;
+    }
+    g->events.erase(g->events.begin(), g->events.begin() + n);
+    return n;
+}
+
+// ---- standalone ready queue (native-mirror for the Python schedulers) ----
+//
+// The Python spq/wdrr schedulers can hand their queue STATE to this
+// object (ownership handoff: the task object stays in a Python dict
+// keyed by handle; the pop ORDER is decided here) — one implementation
+// of the disciplines shared with the pump above, so worker-based and
+// pump-based runs order identically.
+
+void* pz_rq_new(int32_t policy, int32_t quantum, int64_t seed) {
+    SchedQ* q = new SchedQ();
+    q->policy = policy == 1 ? 1 : 0;
+    if (quantum >= 1) q->quantum = quantum;
+    q->seed = seed;
+    if (seed >= 0)
+        q->rng = static_cast<uint64_t>(seed) * 0x9E3779B97F4A7C15ULL +
+                 0x2545F4914F6CDD1DULL;
+    return q;
+}
+
+void pz_rq_destroy(void* qp) { delete static_cast<SchedQ*>(qp); }
+
+void pz_rq_tenant_weight(void* qp, int32_t tenant, int32_t weight) {
+    SchedQ* q = static_cast<SchedQ*>(qp);
+    std::lock_guard<std::mutex> lk(q->mu);
+    q->bin(tenant).weight = weight < 1 ? 1 : weight;
+}
+
+void pz_rq_push(void* qp, int64_t priority, int64_t distance, int32_t tenant,
+                int64_t handle) {
+    SchedQ* q = static_cast<SchedQ*>(qp);
+    std::lock_guard<std::mutex> lk(q->mu);
+    q->push(priority, distance, tenant, handle);
+}
+
+int64_t pz_rq_pop(void* qp) {
+    SchedQ* q = static_cast<SchedQ*>(qp);
+    std::lock_guard<std::mutex> lk(q->mu);
+    return q->pop();
+}
+
+int64_t pz_rq_count(void* qp) {
+    SchedQ* q = static_cast<SchedQ*>(qp);
+    std::lock_guard<std::mutex> lk(q->mu);
+    return q->count;
+}
+
+void pz_rq_clear(void* qp) {
+    SchedQ* q = static_cast<SchedQ*>(qp);
+    std::lock_guard<std::mutex> lk(q->mu);
+    q->clear();
 }
 
 }  // extern "C"

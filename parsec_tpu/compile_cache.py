@@ -1276,7 +1276,13 @@ class _NativeExec:
     aval dtype.  Any mismatch raises loudly; the wrapper above falls
     back to a plain ``jax.jit``."""
 
-    __slots__ = ("le", "device", "in_dtypes", "out_kind", "n_out")
+    __slots__ = ("le", "device", "in_dtypes", "out_kind", "n_out",
+                 "_scalar_memo")
+
+    #: scalar-buffer memo cap — task locals span a parameter space, so
+    #: distinct (value, dtype) pairs are few; the cap only guards a
+    #: pathological caller streaming unbounded distinct scalars
+    _SCALAR_MEMO_MAX = 4096
 
     def __init__(self, le, device, callconv: Dict[str, Any]):
         self.le = le
@@ -1284,6 +1290,26 @@ class _NativeExec:
         self.in_dtypes = [spec[1] for spec in callconv["in"]]
         self.out_kind = callconv["out"]
         self.n_out = int(callconv["n_out"])
+        # (value, dtype) -> device buffer for Python/numpy scalar args.
+        # Task locals (tile indices) repeat across thousands of
+        # dispatches; converting + uploading them per call dominated the
+        # dispatch-bound profile (ISSUE 18).  Executables on this path
+        # never donate (the cache only hands out _NativeExec when
+        # ``not cf.donate``), so a cached input buffer is read-only and
+        # reuse is safe.
+        self._scalar_memo: Dict[Tuple[Any, str], Any] = {}
+
+    def _scalar_buf(self, a, dt):
+        import jax
+        import jax.numpy as jnp
+
+        key = (a, dt)
+        buf = self._scalar_memo.get(key)
+        if buf is None:
+            buf = jax.device_put(jnp.asarray(a, dtype=dt), self.device)
+            if len(self._scalar_memo) < self._SCALAR_MEMO_MAX:
+                self._scalar_memo[key] = buf
+        return buf
 
     def __call__(self, *args):
         import jax
@@ -1297,7 +1323,11 @@ class _NativeExec:
         bufs = []
         for a, dt in zip(leaves, self.in_dtypes):
             if not isinstance(a, jax.Array):
-                a = jax.device_put(jnp.asarray(a, dtype=dt), self.device)
+                if isinstance(a, (int, float, bool, np.number)):
+                    a = self._scalar_buf(a, dt)
+                else:
+                    a = jax.device_put(jnp.asarray(a, dtype=dt),
+                                       self.device)
             else:
                 try:
                     if a.device != self.device:

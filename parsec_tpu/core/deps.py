@@ -37,6 +37,20 @@ def _fire_dep_dec(tracker: "DepTracker | DenseDepTracker", key: Hashable,
                "mode": mode})
 
 
+def fire_native_dep_dec(graph_token: int, task_id: int, ready: bool) -> None:
+    """The native engine's flavor of the same hb site, republished by the
+    batched event drain (dsl.native_exec._EventDrain): one atomic
+    dep-counter decrement observed inside ``pz_graph_done_batch``.  The
+    tracker identity is ``("native", graph hb token)`` — tuple-tagged so
+    it can never collide with a Python tracker's integer token — and the
+    key is the decremented SUCCESSOR's native task id.  Payload shape is
+    this module's, defined once, so every DEP_DECREMENT subscriber
+    (hb-check, binary traces) reads both paths identically."""
+    pins.fire(pins.DEP_DECREMENT, None,
+              {"tracker": ("native", graph_token), "key": task_id,
+               "ready": ready, "mode": "native"})
+
+
 class DepEntry:
     __slots__ = ("count", "mask", "data")
 

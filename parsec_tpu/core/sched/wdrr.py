@@ -20,6 +20,12 @@ tenant runs and priority decides WHAT it runs.
 Select like ``spq``, this is a single global structure (no per-worker
 queues): the serving meshes it exists for are dispatch-bound on the
 device manager, not on queue contention.
+
+With MCA ``sched_native_queue=1`` the bins, ring and deficits live in
+the native engine's SchedQ (``pz_rq_*`` — the exact C++ mirror of this
+module's semantics, shared with the pump scheduler's wdrr mode): pop
+order is identical, queue ops leave the interpreter, and task objects
+stay in a handle-keyed Python dict (ownership handoff on pop).
 """
 
 from __future__ import annotations
@@ -30,7 +36,7 @@ import threading
 from typing import Dict, List, Optional
 
 from ...utils import register_component, mca_param
-from .base import Scheduler
+from .base import Scheduler, native_ready_queue
 
 #: tenant bin for tasks whose pool was never admitted by a service
 _DEFAULT = "_"
@@ -67,13 +73,38 @@ class SchedWDRR(Scheduler):
         self._ring: List[str] = []
         self._cur = 0
         self._count = 0
+        self._nq = native_ready_queue("wdrr", quantum=self._quantum)
+        self._owned: Dict[int, object] = {}
+        #: tenant key -> native tenant index (and its last-set weight)
+        self._nq_tenants: Dict[str, int] = {}
+        self._nq_weights: Dict[str, int] = {}
 
     @staticmethod
     def _key_of(task) -> str:
         return getattr(task.taskpool, "tenant", None) or _DEFAULT
 
+    def _native_tenant(self, task) -> int:
+        key = self._key_of(task)
+        idx = self._nq_tenants.get(key)
+        if idx is None:
+            idx = self._nq_tenants[key] = len(self._nq_tenants) + 1
+        w = max(1, int(getattr(task.taskpool, "tenant_weight", 1)))
+        if self._nq_weights.get(key) != w:
+            # weights are service-managed and may be re-tuned between
+            # jobs; the latest admitted pool wins (same rule as below)
+            self._nq_weights[key] = w
+            self._nq.set_tenant_weight(idx, w)
+        return idx
+
     def schedule(self, es, tasks, distance: int = 0) -> None:
         with self._lock:
+            if self._nq is not None:
+                for t in tasks:
+                    h = next(self._seq)
+                    self._owned[h] = t
+                    self._nq.push(t.priority, h,
+                                  tenant=self._native_tenant(t))
+                return
             for t in tasks:
                 key = self._key_of(t)
                 tq = self._tenants.get(key)
@@ -93,6 +124,9 @@ class SchedWDRR(Scheduler):
 
     def select(self, es) -> Optional["object"]:
         with self._lock:
+            if self._nq is not None:
+                h = self._nq.pop()
+                return None if h < 0 else self._owned.pop(h)
             while self._ring:
                 if self._cur >= len(self._ring):
                     self._cur = 0
@@ -120,10 +154,16 @@ class SchedWDRR(Scheduler):
             return None
 
     def pending_estimate(self) -> int:
-        return self._count
+        return len(self._owned) if self._nq is not None else self._count
 
     def remove(self, context) -> None:
         with self._lock:
+            if self._nq is not None:
+                self._nq.close()
+                self._nq = None
+            self._owned.clear()
+            self._nq_tenants.clear()
+            self._nq_weights.clear()
             self._tenants.clear()
             self._ring.clear()
             self._count = 0

@@ -108,6 +108,30 @@ def complete_execution(context: "Context", es: Optional["ExecutionStream"], task
     tp.task_done(task)
 
 
+def retire_native(tasks: Iterable["Task"], device=None) -> None:
+    """Pump-mode retirement: COMPLETE_EXEC accounting for a batch of
+    native-scheduled device tasks whose successor release already
+    happened inside the native engine (``pz_graph_done_batch``).  Fires
+    the COMPLETE_EXEC pins (gated, with ``es=None``) so critpath / SLO /
+    trace observers keep seeing retirements, marks the tasks retired,
+    and bulk-updates device stats — no ``release_deps``, no
+    ``schedule_ready``: the Python scheduling core never touches these
+    tasks."""
+    begin = pins.active(pins.COMPLETE_EXEC_BEGIN)
+    end = pins.active(pins.COMPLETE_EXEC_END)
+    n = 0
+    for task in tasks:
+        n += 1
+        if begin:
+            pins.fire(pins.COMPLETE_EXEC_BEGIN, None, task)
+        task.status = TaskStatus.COMPLETE
+        task.retired = True
+        if end:
+            pins.fire(pins.COMPLETE_EXEC_END, None, task)
+    if device is not None and n:
+        device.stats["executed_tasks"] += n
+
+
 def task_progress(context: "Context", es: "ExecutionStream", task: "Task") -> HookReturn:
     """Drive one task as far as it will go on this worker."""
     tc = task.task_class

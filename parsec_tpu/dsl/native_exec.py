@@ -14,15 +14,31 @@ Scope: single-rank.  Two body regimes:
 * CPU chores (default) — in-place numpy tiles, Python entered once per
   BODY through the trampoline;
 * **native device dispatch** (``native_device=True``) — classes with an
-  accelerator BODY hand their tasks to the :class:`TpuDevice` manager
-  (manager loop, async lanes, wave batching all intact) and the chore
-  returns ASYNC: the native worker moves on immediately, and the device
-  manager's completion callback signals ``pz_task_done(task_id)``, which
-  runs release_deps/ready-queue/termination *natively*.  Per task the
-  interpreter is entered exactly twice — the enqueue trampoline and the
-  completion callback — never for dependency bookkeeping (the reference
-  keeps device dispatch inside its native hot loop the same way,
-  ``scheduling.c:126-153`` + ``device_gpu.c:2510-2730``).
+  accelerator BODY run through the :class:`TpuDevice` dispatch machinery
+  (staging, wave batching, jit cache all intact) under one of two
+  protocols:
+
+  - **pump mode** (the default for all-device DAGs,
+    ``runtime_native_sched=auto``): the native engine owns the ENTIRE
+    per-task lifecycle — ready-queue ordering (spq priority order, the
+    serve plane's wdrr tenant bins, or the schedule explorer's seeded
+    perturbation), dep-counter decrement on completion, successor
+    pushes and quiescence counting.  A single Python pump loop makes
+    ONE ``pz_graph_pop_batch`` ctypes call per batch of ready tasks,
+    dispatches the batch through the device manager's wave path, and
+    retires it with ONE ``pz_graph_done_batch`` call.  Per task the
+    interpreter is entered **zero** times between attach and drain —
+    no trampoline, no completion callback; Python cost is O(batches).
+    Lifecycle events (dep decrements, publishes, retires) buffer
+    natively and drain in batches into the existing PINS sites when
+    observers (hb-check, binary traces, SLO plane) are installed.
+  - **legacy ASYNC chores** (``runtime_native_sched=off``, or mixed
+    DAGs with CPU-fallback bodies): native worker threads enter Python
+    once to enqueue (chore returns ASYNC) and once per completion
+    callback (``pz_task_done``) — exactly two entries per task, never
+    for dependency bookkeeping (the PR-3 protocol; the reference keeps
+    device dispatch inside its native hot loop the same way,
+    ``scheduling.c:126-153`` + ``device_gpu.c:2510-2730``).
 
 This is the dispatch-bound regime — many small tasks — where
 interpreter overhead dominates the dynamic path (round-5 A/B: ~0.5
@@ -42,6 +58,25 @@ from ..core.task import Chore, Task, TaskClass
 from ..profiling import pins
 from .graph import TaskGraph, capture, source_tile
 from .ptg import CTL, PTGTaskpool, _wrap_device_body
+
+
+def _native_sched_mode() -> str:
+    from ..utils import mca_param
+
+    return str(mca_param.register(
+        "runtime", "native_sched", "auto",
+        help="native-device lifecycle protocol: auto (pump mode — zero "
+             "interpreter entries per task for all-device DAGs) | off "
+             "(legacy ASYNC-chore protocol: two entries per task)"))
+
+
+def _drain_batch() -> int:
+    from ..utils import mca_param
+
+    return int(mca_param.register(
+        "runtime", "native_drain", 256,
+        help="pump-mode batch size: max ready tasks per pz_graph_pop_"
+             "batch call (also floors the lifecycle-event drain buffer)"))
 
 
 class _TaskInfo:
@@ -94,16 +129,139 @@ class _NativeDeviceTask(Task):
     epilog code read its slots unchanged) plus the native task id its
     completion must signal and the PINS opt-in marker."""
 
-    __slots__ = ("native_id", "pins_exec")
+    __slots__ = ("native_id", "pins_exec", "_wbs")
 
     def __init__(self, pool, tclass, locals_, priority):
         super().__init__(pool, tclass, locals_, priority)
         self.native_id = -1
+        #: (source Data, home Data) pairs the pump loop lands at retire
+        #: (pre-resolved cross-tile write-backs; empty in the common case)
+        self._wbs: List[Tuple[Any, Any]] = []
         #: tells TpuDevice to fire EXEC_BEGIN/END (with wave metadata in
         #: ``prof``) around the actual device dispatch: on the native
         #: path no scheduling core wraps the hook, so without this the
         #: trace shows a host-gap hole where device waves ran
         self.pins_exec = True
+
+
+class _EventDrain:
+    """Batched publisher for the native lifecycle-event buffer: maps the
+    engine's (kind, a, b) records onto the existing PINS sites so
+    hb-check, the binary tracer and the SLO plane order native-scheduled
+    runs — with ZERO per-task interpreter work on the hot path (one
+    drain per pump batch).  Kind mapping:
+
+    * ``EVT_DEP_DEC``  -> :data:`pins.DEP_DECREMENT` with tracker
+      ``("native", graph.hb_token)`` (one record per native dep-counter
+      decrement, ``ready`` flagging the release that armed the task);
+    * ``EVT_PUBLISH``  -> :data:`pins.SCHEDULE_BEGIN` with a 1-task
+      batch (the native SchedQ push — ``task_publish`` in hb terms);
+    * ``EVT_RETIRE``   -> :data:`pins.NATIVE_TASK_DONE` (same payload
+      the legacy ``task_done`` path fires, double-completes included).
+    """
+
+    def __init__(self, ng, pump_index: Dict[int, Any], cap: int):
+        import ctypes
+
+        self.ng = ng
+        self.index = pump_index
+        n = max(1024, cap * 4)
+        self.k = (ctypes.c_int32 * n)()
+        self.a = (ctypes.c_int64 * n)()
+        self.b = (ctypes.c_int64 * n)()
+
+    def drain(self) -> int:
+        from ..core.deps import fire_native_dep_dec
+
+        ng = self.ng
+        k, a, b = self.k, self.a, self.b
+        dep_on = pins.active(pins.DEP_DECREMENT)
+        sched_on = pins.active(pins.SCHEDULE_BEGIN)
+        done_on = pins.active(pins.NATIVE_TASK_DONE)
+        token = ng.hb_token
+        total = 0
+        while True:
+            n = ng.events_drain(k, a, b)
+            if n == 0:
+                return total
+            total += n
+            for i in range(n):
+                kind = k[i]
+                if kind == ng.EVT_DEP_DEC:
+                    if dep_on:
+                        fire_native_dep_dec(token, int(a[i]), bool(b[i]))
+                elif kind == ng.EVT_PUBLISH:
+                    if sched_on:
+                        t = self.index.get(a[i])
+                        if t is not None:
+                            pins.fire(pins.SCHEDULE_BEGIN, None, (t,))
+                elif done_on:
+                    pins.fire(pins.NATIVE_TASK_DONE, None, {
+                        "graph": token, "task": int(a[i]),
+                        "accepted": bool(b[i])})
+            if n < len(k):
+                return total
+
+
+def _pump_failure(shims) -> Optional[str]:
+    for s in shims:
+        if s is not None and s.failed:
+            return s.fail_reason or "device submit/epilog failed"
+    return None
+
+
+def _pump_loop(ng, dev, pump_index: Dict[int, Any], stats: Dict[str, int],
+               shims, ev: Optional[_EventDrain] = None,
+               retire_cb=None) -> int:
+    """The zero-interpreter hot loop, shared by :class:`NativeExecutor`
+    and :class:`NativeServeExecutor`.  Per iteration: ONE ``pop_batch``
+    ctypes call returns up to ``runtime_native_drain`` ready native ids,
+    the device manager dispatches them (wave batching intact, completion
+    deferred), rare cross-tile write-backs land, the batch retires
+    through :func:`..core.scheduling.retire_native` (COMPLETE_EXEC pins
+    only), and ONE ``done_batch`` call runs every dep decrement /
+    successor push / quiescence count natively.  Python cost is
+    O(batches), not O(tasks)."""
+    import ctypes
+
+    from ..core import scheduling
+    from ..data.data import land_into_home
+
+    cap = max(1, _drain_batch())
+    buf = (ctypes.c_int64 * cap)()
+    done = 0
+    while True:
+        n = ng.pop_batch(buf)
+        if n == 0:
+            why = _pump_failure(shims)
+            if why is not None:
+                raise RuntimeError(f"native device run failed: {why}")
+            if ng.quiesced():
+                break
+            raise RuntimeError(
+                f"native pump stalled: ready queue empty with {done} "
+                f"retired and {ng.sched_pending()} queued "
+                "(cycle or missing commit?)")
+        stats["pop_batches"] += 1
+        stats["pumped_tasks"] += n
+        batch = [pump_index[buf[i]] for i in range(n)]
+        dev.submit_batch(batch)
+        why = _pump_failure(shims)
+        if why is not None:
+            raise RuntimeError(f"native device run failed: {why}")
+        for t in batch:
+            for (src, home) in t._wbs:
+                land_into_home(home, src.newest_copy().payload)
+        scheduling.retire_native(batch, dev)
+        done += ng.done_batch(buf, n)
+        stats["done_batches"] += 1
+        if retire_cb is not None:
+            retire_cb(batch)
+        if ev is not None:
+            stats["events_drained"] += ev.drain()
+    if ev is not None:
+        stats["events_drained"] += ev.drain()
+    return done
 
 
 class NativeExecutor:
@@ -128,7 +286,8 @@ class NativeExecutor:
 
     def __init__(self, tp: PTGTaskpool, *, graph: Optional[TaskGraph] = None,
                  native_device: bool = False, device=None,
-                 fusion: Optional[str] = None):
+                 fusion: Optional[str] = None,
+                 _shared_graph=None, _tenant: int = 0):
         from .. import native
 
         if not native.available():
@@ -138,6 +297,23 @@ class NativeExecutor:
         self.taskpool = tp
         self.native_device = bool(native_device)
         self.device = device
+        #: control-plane counters the zero-entry pin reads: in pump mode
+        #: ``trampoline_entries`` and ``completion_callbacks`` MUST stay 0
+        #: (every per-task interpreter entry increments one of them)
+        self.stats: Dict[str, int] = {
+            "trampoline_entries": 0, "completion_callbacks": 0,
+            "pop_batches": 0, "done_batches": 0, "pumped_tasks": 0,
+            "events_drained": 0}
+        #: serve mode (NativeServeExecutor): build into ITS shared native
+        #: graph under this tenant id instead of owning one
+        self._shared_graph = _shared_graph
+        self._tenant = int(_tenant)
+        self._pump = False          # zero-entry lifecycle configured
+        self._events_on = False     # native event buffer armed at build
+        self._has_cpu_bodies = False
+        #: native id -> prebuilt device task, the pump loop's dispatch map
+        self._pump_index: Dict[int, _NativeDeviceTask] = {}
+        self._roots: List[int] = []
         self._pool_shim: Optional[_NativePoolShim] = None
         if self.native_device:
             if device is None:
@@ -228,7 +404,8 @@ class NativeExecutor:
         tp = self.taskpool
         g = self.graph
         consts = tp.constants
-        ng = self._native.NativeGraph()
+        ng = self._shared_graph if self._shared_graph is not None \
+            else self._native.NativeGraph()
         self._ng = ng
         index = self._index = {}
 
@@ -245,6 +422,8 @@ class NativeExecutor:
                         priority=max(g.nodes[m].priority
                                      for m in reg.members),
                         user_tag=len(self._bodies))
+                    if self._tenant:
+                        ng.set_task_tenant(rid, self._tenant)
                     region_native[reg.index] = rid
                     self._bodies.append(self._make_fused_dispatch(reg, rid))
                 index[tid] = rid
@@ -252,6 +431,8 @@ class NativeExecutor:
             node = g.nodes[tid]
             index[tid] = ng.add_task(priority=node.priority,
                                      user_tag=len(self._bodies))
+            if self._tenant:
+                ng.set_task_tenant(index[tid], self._tenant)
             self._bodies.append(self._make_body(tid))
             if self.native_device:
                 # the completion callback needs the native id the task
@@ -260,11 +441,13 @@ class NativeExecutor:
                 obj = self._trace_objs.get(tid)
                 if isinstance(obj, _NativeDeviceTask):
                     obj.native_id = index[tid]
+                    self._pump_index[index[tid]] = obj
         # contracted edges are DEDUPLICATED: add_dep is symmetric (one
         # in-degree per declared edge, one release per succs entry), so
         # collapsing parallel region->target edges to one stays balanced
         # while shaving native succs slots and atomic releases
         seen_edges = set()
+        has_pred = set()
         for tid in order:
             me = index[tid]
             for (_f, succ, _sf) in g.nodes[tid].out_edges:
@@ -275,6 +458,41 @@ class NativeExecutor:
                     continue
                 seen_edges.add((me, tgt))
                 ng.add_dep(me, tgt)
+                has_pred.add(tgt)
+        self._roots = [nid for nid in dict.fromkeys(index.values())
+                       if nid not in has_pred]
+        # pump mode (zero-interpreter lifecycle): decided BEFORE the
+        # commit pass because committing pushes source tasks, and those
+        # pushes must land in the configured native SchedQ
+        if self._shared_graph is not None:
+            # the serve executor already called sched_config("wdrr") on
+            # the shared graph; a CPU-fallback body would need the
+            # trampoline protocol the pump never runs
+            if self._has_cpu_bodies:
+                raise RuntimeError(
+                    "NativeServeExecutor requires all-device task "
+                    f"classes ({tp.ptg.name} has CPU-only classes)")
+            self._pump = True
+        elif (self.native_device and not self._has_cpu_bodies
+                and _native_sched_mode() != "off"
+                and getattr(self.device, "_eager", True)):
+            from ..utils import mca_param
+
+            # the schedule explorer's seed reaches the native scheduler
+            # through the SAME param the Python rnd scheduler reads
+            seed = int(mca_param.register(
+                "sched", "rnd_seed", -1,
+                help="seed for the rnd scheduler's RNG (>=0 replays one "
+                     "schedule deterministically — the schedule "
+                     "explorer's replay hook; -1 = unseeded fuzzing)"))
+            ng.sched_config(policy="prio", quantum=0, seed=seed)
+            self._pump = True
+        if self._pump and (pins.active(pins.DEP_DECREMENT)
+                           or pins.active(pins.NATIVE_TASK_DONE)):
+            # observers already installed: arm the native event buffer
+            # now so commit-time source publishes are captured too
+            ng.events_enable(True)
+            self._events_on = True
         # commit only after EVERY edge is declared: committing a task arms
         # it, and a task whose in-edges arrive after arming would release
         # early (the commit token covers a task's own declaration window,
@@ -285,7 +503,8 @@ class NativeExecutor:
             if nid not in committed:
                 committed.add(nid)
                 ng.commit(nid)
-        ng.seal()
+        if self._shared_graph is None:
+            ng.seal()
 
     def _make_fused_dispatch(self, region, native_id: int) -> Callable[[], Any]:
         """Enqueue-only trampoline for a FUSED region: one prebuilt
@@ -335,8 +554,17 @@ class NativeExecutor:
                 wb_map[(cname2, key)] = (src_data, cname2, key)
         wbs = list(wb_map.values())
         ng = self._ng
+        stats = self.stats
+        # write-backs PRE-RESOLVED to (source Data, home Data) pairs: the
+        # pump loop lands them without touching the taskpool (no rebind
+        # with native_device, so build-time resolution is final)
+        task._wbs = [(src_data,
+                      self.taskpool.constants[cname2].data_of(*key))
+                     for (src_data, cname2, key) in wbs]
+        self._pump_index[native_id] = task
 
         def on_complete(t: Task) -> None:
+            stats["completion_callbacks"] += 1
             if wbs:
                 from ..data.data import land_into_home
 
@@ -353,6 +581,7 @@ class NativeExecutor:
         shim = self._pool_shim
 
         def body():
+            stats["trampoline_entries"] += 1
             if shim.failed:
                 raise RuntimeError(
                     f"native device pool failed: {shim.fail_reason}")
@@ -378,6 +607,9 @@ class NativeExecutor:
             pc = self.taskpool.ptg.classes[tid[0]]
             if any(dt != DEV_CPU for dt in pc.bodies):
                 return self._make_device_dispatch(tid)
+            # a CPU-fallback body needs the trampoline protocol: its
+            # presence disqualifies the DAG from the zero-entry pump
+            self._has_cpu_bodies = True
             return self._make_cpu_data_body(tid)
         return self._make_numpy_body(tid)
 
@@ -500,10 +732,16 @@ class NativeExecutor:
 
         wbs = self._write_back_plan(tid)
         ng = self._ng
+        stats = self.stats
+        task._wbs = [(src_data,
+                      self.taskpool.constants[cname2].data_of(*key))
+                     for (src_data, cname2, key) in wbs]
 
         def on_complete(t: Task) -> None:
-            # the ONLY per-task Python on the completion side: land rare
-            # cross-tile write-backs, then signal the native release
+            # the ONLY per-task Python on the completion side (legacy
+            # protocol; the pump never calls it): land rare cross-tile
+            # write-backs, then signal the native release
+            stats["completion_callbacks"] += 1
             if wbs:
                 from ..data.data import land_into_home
 
@@ -519,6 +757,7 @@ class NativeExecutor:
         shim = self._pool_shim
 
         def body():
+            stats["trampoline_entries"] += 1
             if shim.failed:
                 raise RuntimeError(
                     f"native device pool failed: {shim.fail_reason}")
@@ -685,6 +924,8 @@ class NativeExecutor:
                 bodies[user_tag]()
 
             n = self._ng.run(trampoline, nthreads=nthreads)
+        elif self._pump:
+            n = self._run_pump()
         else:
             def atrampoline(_task_id: int, user_tag: int):
                 return bodies[user_tag]()
@@ -707,6 +948,39 @@ class NativeExecutor:
         # report LOGICAL task progress (callers compare against the
         # taskpool's task count; without fusion the two are equal)
         return len(self.graph.nodes)
+
+    def _run_pump(self) -> int:
+        """Drive the zero-interpreter lifecycle for this executor's DAG:
+        see :func:`_pump_loop`.  Between graph attach (commit) and drain
+        (quiescence) NO per-task Python runs — the trampoline and
+        completion callbacks are never installed, and ``self.stats``
+        pins it (``trampoline_entries == completion_callbacks == 0``)."""
+        ng = self._ng
+        drain = self._events_on or pins.active(pins.DEP_DECREMENT) \
+            or pins.active(pins.NATIVE_TASK_DONE)
+        if drain and not self._events_on:
+            # observers installed between build and run: the commit-time
+            # source publishes were never buffered — synthesize them so
+            # hb still orders publish before exec for the roots
+            ng.events_enable(True)
+            self._events_on = True
+            if pins.active(pins.SCHEDULE_BEGIN):
+                for nid in self._roots:
+                    t = self._pump_index.get(nid)
+                    if t is not None:
+                        pins.fire(pins.SCHEDULE_BEGIN, None, (t,))
+        ev = _EventDrain(ng, self._pump_index, _drain_batch()) \
+            if drain else None
+        tp = self.taskpool
+
+        def retire_cb(batch):
+            # batched progress currency: fused supertasks retire all
+            # their members at once (same rule as Taskpool.task_done)
+            tp.task_done_batch(sum(
+                int(getattr(t, "fused_n", 1) or 1) for t in batch))
+
+        return _pump_loop(ng, self.device, self._pump_index, self.stats,
+                          (self._pool_shim,), ev, retire_cb)
 
     def _apply_vpmap(self, nthreads: int) -> None:
         from ..utils import mca_param
@@ -781,6 +1055,10 @@ class NativeExecutor:
                 f"{old_scalars} vs {new_scalars}")
 
     def close(self) -> None:
+        if getattr(self, "_shared_graph", None) is not None:
+            # serve child: graph and device belong to the serve executor
+            self._ng = None
+            return
         ng = getattr(self, "_ng", None)
         if ng is not None:
             ng.close()
@@ -808,12 +1086,163 @@ class NativeExecutor:
             pass
 
 
-def run_native(tp: PTGTaskpool, *, nthreads: int = 4,
+class NativeServeExecutor:
+    """Multi-tenant native pump: N unstarted all-device PTG taskpools
+    share ONE native graph, ONE device (jit cache included) and ONE pump
+    loop; the engine's wdrr SchedQ interleaves tenants by weight with
+    exactly the semantics of ``core/sched/wdrr.py`` — per round-robin
+    visit a tenant's deficit gains ``quantum x weight`` task credits, a
+    drained tenant forfeits its credits and leaves the ring, and within
+    a tenant pops follow (priority desc, insertion order).  A small
+    tenant's tasks therefore keep retiring beside a 6000-task dpotrf
+    backlog: the PR 9 serving-plane fairness contract, preserved under
+    native pop with zero interpreter entries per task.
+
+    ``weights`` maps pool position -> wdrr weight (sequence or dict;
+    default 1).  :meth:`run` returns per-pool logical task counts;
+    :attr:`retire_log` holds ``(pool index, retire position, seconds
+    since pump start)`` per retired native task — the fairness pin and
+    the per-tenant latency metrics read it.
+    """
+
+    def __init__(self, pools: List[PTGTaskpool], *, device=None,
+                 weights=None, seed: int = -1):
+        from .. import native
+        from ..utils import mca_param
+
+        if not native.available():
+            raise RuntimeError(
+                f"native core unavailable: {native.build_error()}")
+        if len(pools) < 1:
+            raise ValueError("NativeServeExecutor needs >= 1 taskpool")
+        self._native = native
+        self.ng = native.NativeGraph()
+        self.device = device if device is not None \
+            else NativeExecutor._make_device()
+        quantum = int(mca_param.register(
+            "sched", "wdrr_quantum", 4,
+            help="task credits a tenant's deficit gains per round-robin "
+                 "visit, scaled by the tenant's weight"))
+        # BEFORE any child builds: commit-time source pushes must land
+        # in the configured wdrr bins
+        self.ng.sched_config(policy="wdrr", quantum=quantum, seed=seed)
+        self.stats: Dict[str, int] = {
+            "trampoline_entries": 0, "completion_callbacks": 0,
+            "pop_batches": 0, "done_batches": 0, "pumped_tasks": 0,
+            "events_drained": 0}
+        self.children: List[NativeExecutor] = []
+        self.retire_log: List[Tuple[int, int, float]] = []
+        self._pos = 0
+        for i, tp in enumerate(pools):
+            if weights is None:
+                w = 1
+            elif isinstance(weights, dict):
+                w = int(weights.get(i, 1))
+            else:
+                w = int(weights[i])
+            self.ng.set_tenant_weight(i + 1, w)
+            self.children.append(NativeExecutor(
+                tp, native_device=True, device=self.device,
+                _shared_graph=self.ng, _tenant=i + 1))
+        self.ng.seal()
+        self._pump_index: Dict[int, _NativeDeviceTask] = {}
+        self._tenant_of: Dict[int, int] = {}
+        for i, ch in enumerate(self.children):
+            self._pump_index.update(ch._pump_index)
+            for nid in ch._pump_index:
+                self._tenant_of[nid] = i
+            # the union pump owns the counters; children share the dict
+            # so their factories' legacy paths (never taken) still count
+            ch.stats = self.stats
+
+    def run(self) -> List[int]:
+        """Pump the union DAG to quiescence; returns per-pool logical
+        task counts (fused regions expanded)."""
+        import time
+
+        if pins.active(pins.RELEASE_DEPS_END):
+            for ch in self.children:
+                ch._emit_trace_edges()
+        ng = self.ng
+        events_on = any(ch._events_on for ch in self.children)
+        drain = events_on or pins.active(pins.DEP_DECREMENT) \
+            or pins.active(pins.NATIVE_TASK_DONE)
+        if drain and not events_on:
+            ng.events_enable(True)
+            if pins.active(pins.SCHEDULE_BEGIN):
+                for ch in self.children:
+                    for nid in ch._roots:
+                        t = self._pump_index.get(nid)
+                        if t is not None:
+                            pins.fire(pins.SCHEDULE_BEGIN, None, (t,))
+        ev = _EventDrain(ng, self._pump_index, _drain_batch()) \
+            if drain else None
+        tenant_of = self._tenant_of
+        log = self.retire_log
+        t0 = time.perf_counter()
+
+        children = self.children
+
+        def retire_cb(batch):
+            now = time.perf_counter() - t0
+            done = [0] * len(children)
+            for t in batch:
+                tenant = tenant_of[t.native_id]
+                self._pos += 1
+                log.append((tenant, self._pos, now))
+                done[tenant] += int(getattr(t, "fused_n", 1) or 1)
+            for i, k in enumerate(done):
+                if k:  # per-tenant progress currency, one call per pool
+                    children[i].taskpool.task_done_batch(k)
+
+        n = _pump_loop(ng, self.device, self._pump_index, self.stats,
+                       [ch._pool_shim for ch in self.children], ev,
+                       retire_cb)
+        expected = sum(len(ch._bodies) for ch in self.children)
+        if n != expected:
+            raise RuntimeError(
+                f"native serve pump retired {n}/{expected} tasks")
+        return [len(ch.graph.nodes) for ch in self.children]
+
+    def close(self) -> None:
+        for ch in getattr(self, "children", ()):
+            ch.close()  # no-op on graph/device: both are shared
+        ng = getattr(self, "ng", None)
+        if ng is not None:
+            ng.close()
+            self.ng = None
+        dev = getattr(self, "device", None)
+        if dev is not None:
+            from ..utils import debug
+
+            try:
+                dev.detach()
+            except Exception as e:
+                debug.error("device detach (final write-back) failed: %s", e)
+                raise
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def run_native(tp, *, nthreads: int = 4,
                native_device: bool = False, device=None) -> int:
     """One-shot: capture + native execution of ``tp``.  With
     ``native_device=True`` accelerator BODYs dispatch through the
-    TpuDevice manager from the native hot loop (ASYNC chores +
-    ``pz_task_done`` completion — see :class:`NativeExecutor`)."""
+    TpuDevice machinery driven by the native scheduler (pump mode —
+    zero interpreter entries per task — or the legacy ASYNC-chore
+    protocol; see :class:`NativeExecutor`).  Passing a LIST of taskpools
+    runs them as wdrr tenants of one shared native graph
+    (:class:`NativeServeExecutor`) and returns per-pool task counts."""
+    if isinstance(tp, (list, tuple)):
+        sx = NativeServeExecutor(list(tp), device=device)
+        try:
+            return sx.run()
+        finally:
+            sx.close()
     ex = NativeExecutor(tp, native_device=native_device, device=device)
     try:
         return ex.run(nthreads=nthreads)

@@ -68,6 +68,14 @@ REQUIRED_SYMBOLS = [
     "pz_graph_set_policy", "pz_graph_steals",
     "pz_graph_steals_remote", "pz_graph_set_vpmap", "pz_graph_reset",
     "pz_graph_run_noop", "pz_graph_order",
+    # zero-interpreter lifecycle (pump mode, PR 18)
+    "pz_graph_sched_config", "pz_graph_task_tenant",
+    "pz_graph_tenant_weight", "pz_graph_pop_batch", "pz_graph_done_batch",
+    "pz_graph_quiesced", "pz_graph_sched_pending",
+    "pz_graph_events_enable", "pz_graph_events_drain",
+    # standalone ready queue (native-mirror for the Python schedulers)
+    "pz_rq_new", "pz_rq_destroy", "pz_rq_tenant_weight", "pz_rq_push",
+    "pz_rq_pop", "pz_rq_count", "pz_rq_clear",
     # binary tracer
     "pt_tracer_new", "pt_tracer_destroy", "pt_stream_new", "pt_stream_id",
     "pt_log", "pt_total_events", "pt_dump",
@@ -194,6 +202,44 @@ def _load():
         lib.pz_graph_order.restype = ctypes.c_int64
         lib.pz_graph_order.argtypes = [ctypes.c_void_p,
                                        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+        # zero-interpreter lifecycle (pump mode)
+        lib.pz_graph_sched_config.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64]
+        lib.pz_graph_task_tenant.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32]
+        lib.pz_graph_tenant_weight.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
+        lib.pz_graph_pop_batch.restype = ctypes.c_int64
+        lib.pz_graph_pop_batch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+        lib.pz_graph_done_batch.restype = ctypes.c_int64
+        lib.pz_graph_done_batch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+        lib.pz_graph_quiesced.restype = ctypes.c_int32
+        lib.pz_graph_quiesced.argtypes = [ctypes.c_void_p]
+        lib.pz_graph_sched_pending.restype = ctypes.c_int64
+        lib.pz_graph_sched_pending.argtypes = [ctypes.c_void_p]
+        lib.pz_graph_events_enable.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.pz_graph_events_drain.restype = ctypes.c_int64
+        lib.pz_graph_events_drain.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64]
+        # standalone ready queue
+        lib.pz_rq_new.restype = ctypes.c_void_p
+        lib.pz_rq_new.argtypes = [ctypes.c_int32, ctypes.c_int32,
+                                  ctypes.c_int64]
+        lib.pz_rq_destroy.argtypes = [ctypes.c_void_p]
+        lib.pz_rq_tenant_weight.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
+        lib.pz_rq_push.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                   ctypes.c_int64, ctypes.c_int32,
+                                   ctypes.c_int64]
+        lib.pz_rq_pop.restype = ctypes.c_int64
+        lib.pz_rq_pop.argtypes = [ctypes.c_void_p]
+        lib.pz_rq_count.restype = ctypes.c_int64
+        lib.pz_rq_count.argtypes = [ctypes.c_void_p]
+        lib.pz_rq_clear.argtypes = [ctypes.c_void_p]
         # binary tracer
         lib.pt_tracer_new.restype = ctypes.c_void_p
         lib.pt_tracer_destroy.argtypes = [ctypes.c_void_p]
@@ -469,6 +515,68 @@ class NativeGraph:
                        "accepted": rc == 0})
         return rc == 0
 
+    # ---- zero-interpreter lifecycle (pump mode) ----------------------
+    #
+    # The batched control-plane API behind NativeExecutor's pump: ONE
+    # ctypes call pops a batch of ready ids, ONE call retires the batch
+    # (dep decrements + ready pushes + quiescence counting all native),
+    # and an optional event drain republishes the lifecycle into PINS.
+
+    #: lifecycle event kinds from :meth:`events_drain` (graph.cpp EvtKind)
+    EVT_DEP_DEC, EVT_PUBLISH, EVT_RETIRE = 0, 1, 2
+
+    SCHED_POLICIES = {"prio": 0, "wdrr": 1}
+
+    def sched_config(self, policy: str = "prio", quantum: int = 0,
+                     seed: int = -1) -> None:
+        """Route ready pushes/pops through the native pump scheduler.
+        ``prio`` pops (priority desc, insertion seq asc) — the spq order;
+        ``wdrr`` runs weighted deficit round robin over tenant bins (see
+        :meth:`set_task_tenant`/:meth:`set_tenant_weight`); ``seed >= 0``
+        applies the schedule explorer's deterministic pop-order
+        perturbation.  Must be called BEFORE tasks commit."""
+        self._lib.pz_graph_sched_config(
+            self._g, self.SCHED_POLICIES[policy], int(quantum), int(seed))
+
+    def set_task_tenant(self, task_id: int, tenant: int) -> None:
+        self._lib.pz_graph_task_tenant(self._g, task_id, int(tenant))
+
+    def set_tenant_weight(self, tenant: int, weight: int) -> None:
+        self._lib.pz_graph_tenant_weight(self._g, int(tenant), int(weight))
+
+    def pop_batch(self, buf) -> int:
+        """Pop up to ``len(buf)`` ready ids into ``buf`` (a preallocated
+        ``ctypes.c_int64`` array); returns the count (0 = none ready)."""
+        return self._lib.pz_graph_pop_batch(self._g, buf, len(buf))
+
+    def done_batch(self, buf, n: int) -> int:
+        """Retire ``buf[:n]`` in one native call — successor release,
+        ready pushes and retire counting never enter the interpreter.
+        Returns the number accepted (double completions are refused per
+        task and counted in :attr:`double_completes`)."""
+        g = self._g
+        if not g:
+            return 0
+        return self._lib.pz_graph_done_batch(g, buf, n)
+
+    def quiesced(self) -> bool:
+        return bool(self._lib.pz_graph_quiesced(self._g))
+
+    def sched_pending(self) -> int:
+        return self._lib.pz_graph_sched_pending(self._g)
+
+    def events_enable(self, on: bool) -> None:
+        self._lib.pz_graph_events_enable(self._g, 1 if on else 0)
+
+    def events_drain(self, kinds, a, b) -> int:
+        """Drain buffered lifecycle events into three preallocated
+        parallel ctypes arrays (c_int32 kinds, c_int64 a/b); returns the
+        count.  Kinds: :data:`EVT_DEP_DEC` (a=succ id, b=ready),
+        :data:`EVT_PUBLISH` (a=task id, b=priority), :data:`EVT_RETIRE`
+        (a=task id, b=accepted)."""
+        return self._lib.pz_graph_events_drain(self._g, kinds, a, b,
+                                               len(kinds))
+
     def fail(self) -> None:
         """Abort a live run: workers drain their current body and exit;
         ``run``/``run_async`` then reports non-quiescence.  Use when an
@@ -519,6 +627,60 @@ class NativeGraph:
                 self._g = None
                 self._closed_handle = None
                 self._lib.pz_graph_destroy(g)
+        except Exception:
+            pass
+
+
+class NativeReadyQueue:
+    """Standalone native ready queue — the queue STATE of a Python
+    scheduler, with pop ORDER decided natively (one shared implementation
+    with the pump disciplines in graph.cpp, so worker-based and
+    pump-based runs order identically).
+
+    Ownership handoff: the caller keeps its task objects in a dict keyed
+    by the integer ``handle`` it pushes; :meth:`pop` returns the handle
+    whose task the caller then owns again.  ``policy``: ``prio`` orders
+    (priority desc, distance asc, insertion seq asc) — the spq key;
+    ``wdrr`` runs deficit round robin over tenant bins."""
+
+    def __init__(self, policy: str = "prio", quantum: int = 0,
+                 seed: int = -1):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native core unavailable: {_build_error}")
+        self._lib = lib
+        self._q = lib.pz_rq_new(NativeGraph.SCHED_POLICIES[policy],
+                                int(quantum), int(seed))
+        if not self._q:
+            raise MemoryError("pz_rq_new failed")
+
+    def set_tenant_weight(self, tenant: int, weight: int) -> None:
+        self._lib.pz_rq_tenant_weight(self._q, int(tenant), int(weight))
+
+    def push(self, priority: int, handle: int, distance: int = 0,
+             tenant: int = 0) -> None:
+        self._lib.pz_rq_push(self._q, int(priority), int(distance),
+                             int(tenant), int(handle))
+
+    def pop(self) -> int:
+        """Next handle under the discipline, or -1 when empty."""
+        return self._lib.pz_rq_pop(self._q)
+
+    def count(self) -> int:
+        return self._lib.pz_rq_count(self._q)
+
+    def clear(self) -> None:
+        self._lib.pz_rq_clear(self._q)
+
+    def close(self) -> None:
+        q = getattr(self, "_q", None)
+        if q:
+            self._q = None
+            self._lib.pz_rq_destroy(q)
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
         except Exception:
             pass
 

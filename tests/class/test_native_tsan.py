@@ -29,8 +29,13 @@ def test_tsan_flavor_compiles_with_engine_symbols(tmp_path):
     assert path.endswith("libparsec_core_tsan.so")
     nm = subprocess.run(["nm", "-D", path], capture_output=True, text=True)
     assert nm.returncode == 0
-    # the async engine the sanitizer is wired for must be in the flavor
-    for sym in ("pz_graph_run_async", "pz_task_done", "pz_graph_fail"):
+    # the async engine the sanitizer is wired for must be in the flavor,
+    # and so must the pump-scheduler hot loop (ISSUE 18: pop/done batches,
+    # sched config, the event drain, and the standalone ready queue)
+    for sym in ("pz_graph_run_async", "pz_task_done", "pz_graph_fail",
+                "pz_graph_pop_batch", "pz_graph_done_batch",
+                "pz_graph_sched_config", "pz_graph_events_drain",
+                "pz_rq_new", "pz_rq_push", "pz_rq_pop"):
         assert sym in nm.stdout, f"{sym} missing from TSan flavor"
     # and it IS instrumented (tsan runtime references present)
     assert "tsan" in nm.stdout or "__tsan" in nm.stdout

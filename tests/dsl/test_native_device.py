@@ -65,9 +65,13 @@ def test_native_device_taskpool_run_native_plumb():
 
 
 def test_native_device_no_python_release_deps():
-    """THE acceptance pin: during a native-dispatched run no per-task
-    Python fires for dependency release or scheduling — only the enqueue
-    trampoline and the completion callback exist.  EXEC spans fire once
+    """THE acceptance pin, tightened from two interpreter entries per
+    task to ZERO: during a pump-mode run no per-task Python fires at
+    all between attach and drain — no enqueue trampoline, no completion
+    callback, no dependency release, no scheduling.  The Python pump
+    makes O(batches) ctypes calls (``pz_graph_pop_batch`` /
+    ``pz_graph_done_batch``) and the executor's counters prove the
+    per-task entry points were never taken.  EXEC spans still fire once
     per task from the device manager, carrying wave metadata; the
     RELEASE_DEPS_BEGIN and SCHEDULE sites (the dynamic runtime's Python
     release path) stay completely silent."""
@@ -94,8 +98,10 @@ def test_native_device_no_python_release_deps():
 
     try:
         ex = NativeExecutor(tp, native_device=True)
+        assert ex._pump, "all-device dpotrf must select pump mode"
         ran = ex.run(nthreads=4)
         dev = ex.device
+        stats = dict(ex.stats)
         ex.close()
     finally:
         pins.clear()
@@ -103,11 +109,19 @@ def test_native_device_no_python_release_deps():
     assert ran == 120
     for site in silent_sites:
         assert counts.get(site, 0) == 0, f"{site} fired on the native path"
+    # ZERO interpreter entries per task: neither legacy path was taken,
+    # and the pump really ran (batched, so far fewer pops than tasks)
+    assert stats["trampoline_entries"] == 0
+    assert stats["completion_callbacks"] == 0
+    assert 1 <= stats["pop_batches"] < 120
+    assert stats["pumped_tasks"] == 120
     # per-task EXEC spans from the device manager, completion spans from
-    # the (enqueue-side) completion path
+    # the batched native retirement
     assert counts[pins.EXEC_BEGIN] == 120
     assert counts[pins.EXEC_END] == 120
     assert counts[pins.COMPLETE_EXEC_BEGIN] == 120
+    # the progress currency still moves (batched task_done_batch)
+    assert tp.nb_retired == 120
     # wave metadata: batched dispatch really happened, and singles are
     # distinguishable (wave == 0)
     assert dev.stats.get("wave_tasks", 0) > 0
