@@ -128,6 +128,12 @@ CODES = {
                         "heartbeats arrive late) — the finding names "
                         "the rank, the class, and the in-flight jobs "
                         "it is currently stalling"),
+    "OBS011": (WARNING, "wedged write-back committer: deferred "
+                        "device->host commits are pending but the "
+                        "committer's drain counter is static (or the "
+                        "committer thread died) — detach()/flush() "
+                        "would block; the finding names the device, "
+                        "the pending count/bytes and any stored error"),
 }
 
 

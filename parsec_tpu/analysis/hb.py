@@ -126,6 +126,11 @@ def analyze_events(events: Iterable[HBEvent]) -> List[Finding]:
             _join(c, store.get(("task", ev.obj)))
         elif kind == "complete_begin":
             _join(c, store.get(("done", ev.obj)))
+        elif kind == "wb_commit":
+            # deferred write-back landing: join the enqueueing thread's
+            # clock (which already covers the task's exec/epilog) so
+            # exec happens-before commit
+            _join(c, store.get(("wb", ev.obj)))
         elif kind == "frame_deliver":
             src = store.get(("frame", ev.obj))
             if src is None:
@@ -186,11 +191,14 @@ def analyze_events(events: Iterable[HBEvent]) -> List[Finding]:
             lw[ev.thread] = ev
 
         # -- release side: publish outgoing edges ------------------------
-        if kind == "dep_edge" or kind == "task_publish":
+        if kind in ("dep_edge", "task_publish", "stage_in"):
             # dep_edge: producer released this successor; task_publish:
             # some thread handed the (now-ready) task to the scheduler —
             # covers hand-offs that bypass RELEASE_DEPS (remote
-            # activations decrementing counters directly)
+            # activations decrementing counters directly); stage_in: the
+            # transfer lane finished prestaging this task's inputs (the
+            # pump only submits after the stage job completes), so
+            # stage_in happens-before the task's exec
             dst_tok = ev.obj[1] if kind == "dep_edge" else ev.obj
             key = ("task", dst_tok)
             merged = store.get(key)
@@ -199,6 +207,11 @@ def analyze_events(events: Iterable[HBEvent]) -> List[Finding]:
             _join(merged, c)
         elif kind == "exec_end":
             store[("done", ev.obj)] = dict(c)
+        elif kind == "wb_enqueue":
+            # the epilog thread hands this output to the async committer:
+            # publish its clock under the ticket so the later wb_commit
+            # joins it (exec happens-before write-back commit)
+            store[("wb", ev.obj)] = dict(c)
         elif kind == "frame_send":
             saw_frame_send = True
             store[("frame", ev.obj)] = dict(c)
@@ -331,6 +344,20 @@ class HBRecorder:
         # complete_begin, fired earlier on the retirement path
         sub(pins.DEVICE_EPILOG_BEGIN, lambda es, task: self._rec(
             "complete_begin", self._task_token(task)))
+        # staging-pipeline edges (round 19): the transfer lane finishing
+        # a task's prestage happens-before that task's exec; a task's
+        # epilog handing an output to the async committer happens-before
+        # the committer landing it on the host
+        sub(pins.HB_STAGE_IN, lambda es, p: self._rec(
+            "stage_in", self._task_token(p["task"])))
+        sub(pins.HB_WB_ENQUEUE, lambda es, p: self._rec(
+            "wb_enqueue", p["ticket"]))
+
+        def on_wb_commit(es, p):
+            for t in p.get("tickets") or ():
+                self._rec("wb_commit", t)
+
+        sub(pins.HB_WB_COMMIT, on_wb_commit)
         return self
 
     def uninstall(self) -> None:
@@ -436,6 +463,9 @@ TRACE_KINDS = {
     "hb_frame_send": "frame_send",
     "hb_frame_deliver": "frame_deliver",
     "hb_task_done": "task_done",
+    "hb_stage_in": "stage_in",
+    "hb_wb_enqueue": "wb_enqueue",
+    "hb_wb_commit": "wb_commit",
 }
 
 
@@ -469,6 +499,10 @@ def events_from_trace(paths: Iterable[str]) -> List[HBEvent]:
                     obj = eid
                 elif kind == "task_done":
                     obj, extra = ("ntask", eid), {"accepted": bool(info)}
+                elif kind == "stage_in":
+                    obj = eid          # task token (same space as exec)
+                elif kind in ("wb_enqueue", "wb_commit"):
+                    obj = eid          # committer ticket
             elif name == "dep_edge" and ph == "i":
                 kind, obj = "dep_edge", (eid, info)
             elif name == "sched_publish" and ph == "i":

@@ -59,6 +59,29 @@ except Exception:  # pragma: no cover
     _HAVE_JAX = False
 
 
+def _unalias(arr, x, guard, jdev):
+    """Rerun a host->device transfer from a throwaway copy when the
+    result aliases ``guard`` (shared by :func:`private_device_put` and
+    the batched stage-in path — the guard contract must be identical
+    whether a tile travelled alone or coalesced)."""
+    plat = getattr(jdev, "platform", None)
+    if plat is None:
+        try:
+            plat = arr.devices().pop().platform
+        except Exception:
+            plat = "cpu"  # unknown: err on the safe side
+    if plat != "cpu":
+        return arr
+    try:
+        if np.shares_memory(np.asarray(arr), guard):
+            priv = np.array(np.asarray(x), copy=True)
+            arr = jax.device_put(priv, jdev) if jdev is not None \
+                else jnp.asarray(priv)
+    except Exception:
+        pass
+    return arr
+
+
 def private_device_put(x, jdev=None, *, guard=None):
     """``jax.device_put`` whose result is guaranteed NOT to alias
     ``guard`` (a host numpy array someone retains).  On the CPU backend
@@ -76,22 +99,7 @@ def private_device_put(x, jdev=None, *, guard=None):
     arr = jax.device_put(x, jdev) if jdev is not None else jnp.asarray(x)
     if guard is None:
         return arr
-    plat = getattr(jdev, "platform", None)
-    if plat is None:
-        try:
-            plat = arr.devices().pop().platform
-        except Exception:
-            plat = "cpu"  # unknown: err on the safe side
-    if plat != "cpu":
-        return arr
-    try:
-        if np.shares_memory(np.asarray(arr), guard):
-            priv = np.array(np.asarray(x), copy=True)
-            arr = jax.device_put(priv, jdev) if jdev is not None \
-                else jnp.asarray(priv)
-    except Exception:
-        pass
-    return arr
+    return _unalias(arr, x, guard, jdev)
 
 
 class _InFlight:
@@ -262,6 +270,33 @@ class TpuDevice(Device):
                     self._zone = native.ZoneAllocator(self.hbm_budget)
             except Exception:
                 self._zone = None
+        # -- async staging pipeline (device/staging.py) ------------------
+        #: residency lock: LRU/zone/accounting mutations are no longer
+        #: single-threaded once the transfer lane prestages wave N+1
+        #: while the pump thread commits wave N's epilogs.  RLock — the
+        #: stage/evict/realloc paths nest.  Order: _lock -> _res_lock ->
+        #: Data.lock; the committer takes only Data.lock, so an eviction
+        #: waiting on it under _res_lock cannot deadlock.
+        self._res_lock = threading.RLock()
+        from .staging import stage_depth_param
+
+        #: pipeline depth (runtime_stage_depth): 1 = synchronous
+        #: transfers (no prefetch lane, no committer — the A/B OFF arm);
+        #: >= 2 arms the prefetch window and the write-back committer
+        self.stage_depth = stage_depth_param()
+        #: the pump's intra-wave split threshold: a lone ready batch is
+        #: re-sliced across the prefetch window only when its prestage
+        #: would move at least this many bytes — splitting shrinks
+        #: vmappable waves, so it must buy real transfer overlap
+        self.stage_split_bytes = max(0, int(mca_param.register(
+            "runtime", "stage_split_kb", 256,
+            help="min host->device bytes (KB) a ready batch must need "
+                 "staged before the pump re-slices it across the "
+                 "prefetch window (intra-wave double buffering)"))) << 10
+        self._committer = None
+        #: eviction's bounded wait for an async victim commit before the
+        #: synchronous fallback (satellite: capacity wait, not a hang)
+        self._wb_wait = 60.0
 
     @property
     def hbm_budget(self) -> int:
@@ -628,6 +663,13 @@ class TpuDevice(Device):
         while remaining:
             cnt = 1 << (remaining.bit_length() - 1)  # largest pow2 chunk
             grp = tasks[start:start + cnt]
+            if self.stage_depth > 1:
+                # tentpole (c): coalesce this chunk's host->device tile
+                # transfers into one batched put; staging stays PER
+                # CHUNK (PR 1 invariant above), and _stage_task_args
+                # below finds the tiles already resident so the per-tile
+                # path degenerates to cache hits
+                self._stage_in_batch(self._collect_stage_tiles(grp))
             gst = [self._stage_task_args(t, body) for t in grp]
             if arity is None:
                 arity = len(gst[0][0])
@@ -861,74 +903,243 @@ class TpuDevice(Device):
         stage_in writes into the GPU copy buffer the same way); residency
         is accounted at the STAGED size, which may differ from the home
         tile's (packed subtile)."""
-        mine = data.get_copy(self.data_index)
-        newest = data.newest_copy()
-        if mine is not None and newest is not None \
-                and mine.version >= newest.version and mine.payload is not None \
-                and getattr(mine, "staged_by", None) is hook:
-            # reusable ONLY if this same hook produced it: a current
-            # device copy staged by the default path (prefetch, a prior
-            # epilog) holds the HOME representation, not the packed one
-            self._lru_touch(data, dirty=mine.coherency is Coherency.OWNED)
-            return mine.payload
-        if mine is not None and mine.payload is not None \
-                and getattr(mine, "staged_by", None) is None:
-            host = data.get_copy(0)
-            if host is None or host.payload is None \
-                    or host.version < mine.version:
-                # the device copy is the ONLY up-to-date home-layout
-                # replica: flush it home BEFORE the packed staging
-                # replaces it, or that data exists nowhere (and the
-                # hook itself typically reads the host copy)
-                self._writeback(data)
-        arr = hook(data, self)
-        old = mine.nbytes if (mine is not None and mine.payload is not None) else 0
-        self._hbm_realloc(data, old, arr.nbytes)
-        arr = jax.device_put(arr, self.jdev)
-        self.stats["bytes_in"] += arr.nbytes
-        self.stats["custom_stage_in"] = self.stats.get("custom_stage_in", 0) + 1
-        c = data.attach_copy(self.data_index, arr)
-        c.version = newest.version if newest is not None else 0
-        c.staged_by = hook
-        self._lru_touch(data, dirty=False)
-        return arr
+        with self._res_lock:
+            mine = data.get_copy(self.data_index)
+            newest = data.newest_copy()
+            if mine is not None and newest is not None \
+                    and mine.version >= newest.version and mine.payload is not None \
+                    and getattr(mine, "staged_by", None) is hook:
+                # reusable ONLY if this same hook produced it: a current
+                # device copy staged by the default path (prefetch, a prior
+                # epilog) holds the HOME representation, not the packed one
+                self._lru_touch(data, dirty=mine.coherency is Coherency.OWNED)
+                return mine.payload
+            if mine is not None and mine.payload is not None \
+                    and getattr(mine, "staged_by", None) is None:
+                host = data.get_copy(0)
+                if host is None or host.payload is None \
+                        or host.version < mine.version:
+                    # the device copy is the ONLY up-to-date home-layout
+                    # replica: flush it home BEFORE the packed staging
+                    # replaces it, or that data exists nowhere (and the
+                    # hook itself typically reads the host copy).  A
+                    # deferred commit may still be pending for this tile —
+                    # the synchronous flush lands the same version first
+                    # and the committer's guarded commit drops as stale.
+                    self._writeback(data)
+            arr = hook(data, self)
+            old = mine.nbytes if (mine is not None and mine.payload is not None) else 0
+            self._hbm_realloc(data, old, arr.nbytes)
+            arr = jax.device_put(arr, self.jdev)
+            self.stats["bytes_in"] += arr.nbytes
+            self.stats["custom_stage_in"] = self.stats.get("custom_stage_in", 0) + 1
+            c = data.attach_copy(self.data_index, arr)
+            c.version = newest.version if newest is not None else 0
+            c.staged_by = hook
+            self._lru_touch(data, dirty=False)
+            return arr
 
     def _stage_in(self, data: Data) -> Any:
         """Materialize the newest version of ``data`` on this device."""
-        mine = data.get_copy(self.data_index)
-        if mine is not None and getattr(mine, "staged_by", None) is not None:
-            # a custom-staged PACKED representation must never be served
-            # as the home layout: drop it and restage from the host copy
-            # (which _stage_in_custom flushed to the same version)
-            self._drop_copy(data, evicted=False)
-            mine = None
-        newest = data.newest_copy()
-        if mine is not None and newest is not None and mine.version >= newest.version and mine.payload is not None:
-            self._lru_touch(data, dirty=mine.coherency is Coherency.OWNED)
-            return mine.payload
-        if newest is None:
-            raise RuntimeError(f"{data!r}: no valid copy to stage in")
-        # re-staging over a stale device copy replaces it: account the delta
-        old = mine.nbytes if (mine is not None and mine.payload is not None) else 0
-        if isinstance(newest.payload, jax.Array):
-            # device-resident arrival (device-capable fabric): land it
-            # with a direct device_put — device-to-device, ICI-class on
-            # multi-chip, no host numpy bounce (SURVEY §5.8)
-            self._hbm_realloc(data, old, newest.payload.nbytes)
-            arr = jax.device_put(newest.payload, self.jdev)
-            self.stats["bytes_d2d"] += newest.payload.nbytes
-        else:
-            host = np.asarray(newest.payload)
-            self._hbm_realloc(data, old, host.nbytes)
-            # guard: the host copy RETAINS this buffer at version v — a
-            # zero-copy put followed by a donating task would overwrite
-            # it in place while its version still claims v
-            arr = private_device_put(host, self.jdev, guard=host)
-            self.stats["bytes_in"] += host.nbytes
-        c = data.attach_copy(self.data_index, arr)
-        c.version = newest.version
-        self._lru_touch(data, dirty=False)
-        return arr
+        with self._res_lock:
+            mine = data.get_copy(self.data_index)
+            if mine is not None and getattr(mine, "staged_by", None) is not None:
+                # a custom-staged PACKED representation must never be served
+                # as the home layout: drop it and restage from the host copy
+                # (which _stage_in_custom flushed to the same version)
+                self._drop_copy(data, evicted=False)
+                mine = None
+            newest = data.newest_copy()
+            if mine is not None and newest is not None and mine.version >= newest.version and mine.payload is not None:
+                self._lru_touch(data, dirty=mine.coherency is Coherency.OWNED)
+                return mine.payload
+            if newest is None:
+                raise RuntimeError(f"{data!r}: no valid copy to stage in")
+            # re-staging over a stale device copy replaces it: account the delta
+            old = mine.nbytes if (mine is not None and mine.payload is not None) else 0
+            if isinstance(newest.payload, jax.Array):
+                # device-resident arrival (device-capable fabric): land it
+                # with a direct device_put — device-to-device, ICI-class on
+                # multi-chip, no host numpy bounce (SURVEY §5.8)
+                self._hbm_realloc(data, old, newest.payload.nbytes)
+                arr = jax.device_put(newest.payload, self.jdev)
+                self.stats["bytes_d2d"] += newest.payload.nbytes
+            else:
+                host = np.asarray(newest.payload)
+                self._hbm_realloc(data, old, host.nbytes)
+                # guard: the host copy RETAINS this buffer at version v — a
+                # zero-copy put followed by a donating task would overwrite
+                # it in place while its version still claims v
+                arr = private_device_put(host, self.jdev, guard=host)
+                self.stats["bytes_in"] += host.nbytes
+            c = data.attach_copy(self.data_index, arr)
+            c.version = newest.version
+            self._lru_touch(data, dirty=False)
+            return arr
+
+    # ------------------------------------------------------------------
+    # async staging pipeline: prefetch lane + batched puts
+    # ------------------------------------------------------------------
+    def _collect_stage_tiles(self, tasks: List[Task]) -> List[Data]:
+        """The unique PLAIN input tiles of ``tasks`` — flows the default
+        stage-in path will serve: readable, not custom-staged (a hook's
+        packed layout is the hook's business), deduplicated per tile."""
+        out: List[Data] = []
+        seen = set()
+        for task in tasks:
+            chore = task.selected_chore
+            body = chore.body_fn if chore is not None else None
+            si_hooks = getattr(body, "_stage_in", None) or {}
+            data_idx = -1
+            for spec in task.body_args or ():
+                kind, payload, mode = spec
+                if kind != "data":
+                    continue
+                data_idx += 1
+                if payload is None or si_hooks.get(data_idx) is not None:
+                    continue
+                if (mode & AccessMode.INOUT) == AccessMode.OUT:
+                    continue  # write-only: no H2D needed
+                if payload.data_id in seen:
+                    continue
+                seen.add(payload.data_id)
+                out.append(payload)
+        return out
+
+    def _stage_in_batch(self, datas: List[Data]) -> int:
+        """Batched :meth:`_stage_in`: resident tiles are touched, stale
+        host-side tiles are coalesced into ONE ``jax.device_put`` call
+        (tentpole (c) — one enqueue RPC for the wave's transfers instead
+        of one per tile), each result re-checked against the per-tile
+        aliasing guard.  Returns bytes moved host->device."""
+        moved = 0
+        with self._res_lock:
+            puts: List[Tuple[Data, np.ndarray, int]] = []
+            for data in datas:
+                mine = data.get_copy(self.data_index)
+                if mine is not None and getattr(mine, "staged_by", None) is not None:
+                    self._drop_copy(data, evicted=False)
+                    mine = None
+                newest = data.newest_copy()
+                if mine is not None and newest is not None \
+                        and mine.version >= newest.version \
+                        and mine.payload is not None:
+                    self._lru_touch(
+                        data, dirty=mine.coherency is Coherency.OWNED)
+                    continue
+                if newest is None:
+                    raise RuntimeError(f"{data!r}: no valid copy to stage in")
+                old = mine.nbytes if (mine is not None
+                                      and mine.payload is not None) else 0
+                if isinstance(newest.payload, jax.Array):
+                    # device-resident arrival: direct d2d put, uncoalesced
+                    self._hbm_realloc(data, old, newest.payload.nbytes)
+                    arr = jax.device_put(newest.payload, self.jdev)
+                    self.stats["bytes_d2d"] += newest.payload.nbytes
+                    c = data.attach_copy(self.data_index, arr)
+                    c.version = newest.version
+                    self._lru_touch(data, dirty=False)
+                    moved += newest.payload.nbytes
+                    continue
+                host = np.asarray(newest.payload)
+                self._hbm_realloc(data, old, host.nbytes)
+                puts.append((data, host, newest.version))
+            if puts:
+                try:
+                    arrs = jax.device_put([h for (_d, h, _v) in puts],
+                                          self.jdev)
+                except Exception:
+                    # backend rejected the coalesced put: per-tile path
+                    arrs = [private_device_put(h, self.jdev, guard=h)
+                            for (_d, h, _v) in puts]
+                else:
+                    arrs = [_unalias(a, h, h, self.jdev)
+                            for a, (_d, h, _v) in zip(arrs, puts)]
+                for (data, host, ver), arr in zip(puts, arrs):
+                    self.stats["bytes_in"] += host.nbytes
+                    c = data.attach_copy(self.data_index, arr)
+                    c.version = ver
+                    self._lru_touch(data, dirty=False)
+                    moved += host.nbytes
+                self.stats["stage_batched_puts"] = \
+                    self.stats.get("stage_batched_puts", 0) + 1
+                self.stats["stage_batched_tiles"] = \
+                    self.stats.get("stage_batched_tiles", 0) + len(puts)
+        return moved
+
+    def prestage_bytes(self, tasks: List[Task]) -> int:
+        """Cheap upper bound on the host->device bytes a prestage of
+        ``tasks`` would move — the pump's intra-wave split heuristic:
+        re-slicing a ready batch across the prefetch window only pays
+        when there is real transfer work to hide.  Deliberately
+        lock-free: a stale read merely mis-sizes the hint."""
+        total = 0
+        for data in self._collect_stage_tiles(tasks):
+            mine = data.get_copy(self.data_index)
+            newest = data.newest_copy()
+            if newest is None or newest.payload is None:
+                continue
+            if mine is not None and mine.payload is not None \
+                    and getattr(mine, "staged_by", None) is None \
+                    and mine.version >= newest.version:
+                continue  # residency hit: no transfer
+            total += int(getattr(newest.payload, "nbytes", 0))
+        return total
+
+    def prestage_batch(self, tasks: List[Task]) -> None:
+        """Transfer-lane half of the double-buffered pipeline: stage the
+        NEXT ready batch's input tiles while the current wave computes,
+        so the pump's submit pass reuse-hits them.  Fired as a
+        ``stage_in`` span (critpath's transfer bucket) and publishes the
+        lane's clock into each task's hb token — stage_in happens-before
+        exec."""
+        from .staging import _SPAN_SEQ
+
+        datas = self._collect_stage_tiles(tasks)
+        span = pins.active(pins.STAGE_IN_BEGIN)
+        if span:
+            import time
+
+            info = {"rank": getattr(self.context, "rank", 0),
+                    "id": next(_SPAN_SEQ), "tiles": len(datas),
+                    "bytes": 0}
+            pins.fire(pins.STAGE_IN_BEGIN, None, info)
+            t0 = time.perf_counter()
+        moved = self._stage_in_batch(datas)
+        self.stats["prefetched_tiles"] = \
+            self.stats.get("prefetched_tiles", 0) + len(datas)
+        if span:
+            info = dict(info)
+            info["bytes"] = moved
+            info["seconds"] = time.perf_counter() - t0
+            pins.fire(pins.STAGE_IN_END, None, info)
+        if pins.active(pins.HB_STAGE_IN):
+            for task in tasks:
+                pins.fire(pins.HB_STAGE_IN, None, {"task": task})
+
+    def _wb_committer(self):
+        """The async write-back committer, armed lazily when the
+        pipeline is on (``runtime_stage_depth`` >= 2); None in the
+        synchronous regime."""
+        if self.stage_depth <= 1:
+            return None
+        com = self._committer
+        if com is None:
+            from .staging import WritebackCommitter
+
+            com = self._committer = WritebackCommitter(self)
+        return com
+
+    def flush(self, timeout: float = 300.0) -> None:
+        """Hard write-back barrier: drain every deferred device->host
+        commit (or re-raise the committer's sticky error).  Detach calls
+        this implicitly; call it directly when host tiles must be
+        current while the device stays attached — e.g. between a
+        standalone ``NativeExecutor`` run and a host-side read of the
+        raw tile copies.  A no-op in the synchronous regime."""
+        com = self._committer
+        if com is not None:
+            com.flush(timeout=timeout)
 
     # ------------------------------------------------------------------
     # HBM budget + dual LRU eviction
@@ -936,37 +1147,70 @@ class TpuDevice(Device):
     def _reserve(self, nbytes: int) -> None:
         """Make room: evict clean first, then write back dirty tiles
         (reference device_gpu.c:978-1120 retry/evict loops)."""
-        guard = 0
-        while self.hbm_used + nbytes > self.hbm_budget and guard < 10000:
-            guard += 1
-            if not self._evict_one():
-                break  # nothing evictable; trust the PJRT allocator
+        with self._res_lock:
+            guard = 0
+            while self.hbm_used + nbytes > self.hbm_budget and guard < 10000:
+                guard += 1
+                if not self._evict_one():
+                    break  # nothing evictable; trust the PJRT allocator
 
     def _evict_one(self) -> bool:
-        if self._lru_clean:
-            _, victim = self._lru_clean.popitem(last=False)
-            mine = victim.get_copy(self.data_index)
-            host = victim.get_copy(0)
-            if mine is not None and (host is None or host.payload is None
-                                     or host.version < mine.version):
-                # a CLEAN device copy can still be the ONLY valid copy:
-                # device-native arrivals (_deposit_payload, bytes_d2d)
-                # attach no host copy — dropping without write-back would
-                # destroy the data
+        with self._res_lock:
+            if self._lru_clean:
+                _, victim = self._lru_clean.popitem(last=False)
+                mine = victim.get_copy(self.data_index)
+                host = victim.get_copy(0)
+                if mine is not None and (host is None or host.payload is None
+                                         or host.version < mine.version):
+                    # a CLEAN device copy can still be the ONLY valid copy:
+                    # device-native arrivals (_deposit_payload, bytes_d2d)
+                    # attach no host copy — dropping without write-back would
+                    # destroy the data
+                    self._writeback_evict(victim)
+                self._drop_copy(victim)
+                return True
+            if self._lru_dirty:
+                _, victim = self._lru_dirty.popitem(last=False)
+                self._writeback_evict(victim)
+                self._drop_copy(victim)
+                return True
+            return False
+
+    def _writeback_evict(self, victim: Data) -> None:
+        """Eviction write-back, routed through the async committer when
+        the pipeline is on (satellite fix: the synchronous ``_writeback``
+        inside ``_stage_in`` blocked the whole staging path on a D2H
+        get).  The wait is a CAPACITY wait, bounded: the victim's bytes
+        must exist at home before its device copy drops, so a wedged or
+        failed committer falls back to the synchronous path — data
+        safety first, the version guard makes the duplicate a no-op."""
+        com = self._committer
+        if com is not None and com.healthy:
+            try:
+                com.enqueue(victim)
+            except Exception:
+                # committer died between the check and the enqueue: the
+                # sync fallback still flushes the victim; the sticky
+                # error surfaces at the next epilog enqueue/flush
                 self._writeback(victim)
-            self._drop_copy(victim)
-            return True
-        if self._lru_dirty:
-            _, victim = self._lru_dirty.popitem(last=False)
-            self._writeback(victim)
-            self._drop_copy(victim)
-            return True
-        return False
+                return
+            if com.wait_for(victim.data_id, timeout=self._wb_wait):
+                return
+            debug.warning(
+                "async write-back of eviction victim %r did not land in "
+                "%.0fs; falling back to a synchronous flush",
+                victim, self._wb_wait)
+        self._writeback(victim)
 
     def _hbm_realloc(self, data: Data, old_nbytes: int, new_nbytes: int) -> None:
         """(Re)account ``data``'s residency slot, evicting for space. With
         the native zone, alignment + fragmentation are modelled for real:
         an allocation can fail even under budget and trigger eviction."""
+        with self._res_lock:
+            self._hbm_realloc_locked(data, old_nbytes, new_nbytes)
+
+    def _hbm_realloc_locked(self, data: Data, old_nbytes: int,
+                            new_nbytes: int) -> None:
         # the allocatee must not be its own eviction victim (either mode):
         # callers re-touch the LRU right after accounting
         self._lru_clean.pop(data.data_id, None)
@@ -998,51 +1242,131 @@ class TpuDevice(Device):
                 self._accounted[data.data_id] = new_nbytes
 
     def _hbm_free(self, data: Data, nbytes: int) -> None:
-        if self._zone is not None:
-            slot = self._offsets.pop(data.data_id, None)
-            if slot is not None:
-                self._zone.release(slot[0])
-            self.hbm_used = self._zone.used
-        else:
-            self.hbm_used -= self._accounted.pop(data.data_id, 0)
+        with self._res_lock:
+            if self._zone is not None:
+                slot = self._offsets.pop(data.data_id, None)
+                if slot is not None:
+                    self._zone.release(slot[0])
+                self.hbm_used = self._zone.used
+            else:
+                self.hbm_used -= self._accounted.pop(data.data_id, 0)
 
     def _drop_copy(self, data: Data, *, evicted: bool = True) -> None:
-        c = data.detach_copy(self.data_index)
-        if c is not None:
-            self._hbm_free(data, c.nbytes)
-            if evicted:
-                self.stats["evictions"] += 1
+        with self._res_lock:
+            c = data.detach_copy(self.data_index)
+            if c is not None:
+                self._hbm_free(data, c.nbytes)
+                if evicted:
+                    self.stats["evictions"] += 1
 
-    def _writeback(self, data: Data) -> None:
-        """Write-back-to-rest eviction of a dirty tile (reference w2r tasks,
-        ``parsec_gpu_create_w2r_task``)."""
-        c = data.get_copy(self.data_index)
-        if c is None or c.payload is None:
-            return
-        if getattr(c, "staged_by", None) is not None:
-            # packed custom-staged representation: flushing it home would
-            # corrupt the home tile; the host copy already holds the same
-            # version in home layout (_stage_in_custom pre-flushes)
-            return
-        hc = data.get_copy(0)
-        if hc is not None and hc.payload is not None and hc.version >= c.version:
-            # the host already holds this version OR NEWER (a CPU body
-            # consumed the device output and bumped past it — the mixed
-            # native_device DAG shape): flushing the stale device copy
-            # would roll the tile back
-            return
-        host = np.asarray(c.payload)  # D2H
+    def _wb_snapshot(self, data: Data):
+        """Version-guarded snapshot of a dirty device copy: returns
+        ``(payload, version)`` to commit home, or None when the commit
+        would be wrong or redundant.  Taken under the Data lock so a
+        concurrent epilog rebind cannot tear payload from version."""
+        with data.lock:
+            c = data.get_copy(self.data_index)
+            if c is None or c.payload is None:
+                return None
+            if getattr(c, "staged_by", None) is not None:
+                # packed custom-staged representation: flushing it home
+                # would corrupt the home tile; the host copy already holds
+                # the same version in home layout (_stage_in_custom
+                # pre-flushes)
+                return None
+            hc = data.get_copy(0)
+            if hc is not None and hc.payload is not None \
+                    and hc.version >= c.version:
+                # the host already holds this version OR NEWER (a CPU body
+                # consumed the device output and bumped past it — the mixed
+                # native_device DAG shape): flushing the stale device copy
+                # would roll the tile back
+                return None
+            return (c.payload, c.version)
+
+    def _commit_host(self, data: Data, version: int, host) -> bool:
+        """Land a D2H'd payload as the host copy at ``version``.  The
+        guard re-checks under the Data lock: a newer commit that landed
+        while our get was in flight wins and ours drops (stale commits
+        are safe to drop — the PR 3 version guard).  Deliberately NO
+        version_bump: the committed value is the same write the device
+        epilog already bumped for, and a second bump would make every
+        deferred commit an RT001 unordered-writer false positive."""
         if not host.flags.writeable:
             host = host.copy()  # host copies must be mutable for CPU bodies
-        hc = data.attach_copy(0, host)
-        hc.version = c.version
-        hc.coherency = Coherency.SHARED
+        with data.lock:
+            hc = data.get_copy(0)
+            if hc is not None and hc.payload is not None \
+                    and hc.version >= version:
+                return False
+            hc = data.attach_copy(0, host)
+            hc.version = version
+            hc.coherency = Coherency.SHARED
         self.stats["bytes_out"] += host.nbytes
+        return True
+
+    def _d2h_batch(self, payloads: List[Any]) -> List[np.ndarray]:
+        """Batched device->host gets: ONE device sync for the whole
+        batch, then the (now-ready) buffers convert without further
+        blocking — the coalesced-gets half of tentpole (c)."""
+        try:
+            jax.block_until_ready(payloads)
+        except Exception:
+            pass  # non-jax payloads (tests): asarray below still works
+        return [np.asarray(p) for p in payloads]
+
+    def _writeback(self, data: Data) -> None:
+        """Synchronous write-back-to-rest of a dirty tile (reference w2r
+        tasks, ``parsec_gpu_create_w2r_task``); the pipeline's deferred
+        path shares its snapshot/commit halves."""
+        snap = self._wb_snapshot(data)
+        if snap is None:
+            return
+        payload, version = snap
+        host = np.asarray(payload)  # D2H
+        self._commit_host(data, version, host)
+
+    def _writeback_batch(self, datas: List[Data]) -> int:
+        """Batched synchronous flush (the ``detach()`` path): snapshot
+        every dirty tile, ONE device sync + coalesced gets, guarded
+        commits — instead of one blocking get per tile in dict order.
+        Returns the number of tiles actually committed."""
+        from .staging import _SPAN_SEQ
+
+        snaps = []
+        for d in datas:
+            s = self._wb_snapshot(d)
+            if s is not None:
+                snaps.append((d, s[0], s[1]))
+        if not snaps:
+            return 0
+        span = pins.active(pins.WRITEBACK_BEGIN)
+        if span:
+            import time
+
+            info = {"rank": getattr(self.context, "rank", 0),
+                    "id": next(_SPAN_SEQ), "tiles": len(snaps),
+                    "bytes": sum(int(getattr(p, "nbytes", 0))
+                                 for (_d, p, _v) in snaps)}
+            pins.fire(pins.WRITEBACK_BEGIN, None, info)
+            t0 = time.perf_counter()
+        hosts = self._d2h_batch([p for (_d, p, _v) in snaps])
+        committed = 0
+        for (data, _p, version), host in zip(snaps, hosts):
+            if self._commit_host(data, version, host):
+                committed += 1
+        self.stats["wb_batches"] = self.stats.get("wb_batches", 0) + 1
+        if span:
+            info = dict(info)
+            info["seconds"] = time.perf_counter() - t0
+            pins.fire(pins.WRITEBACK_END, None, info)
+        return committed
 
     def _lru_touch(self, data: Data, *, dirty: bool) -> None:
-        self._lru_clean.pop(data.data_id, None)
-        self._lru_dirty.pop(data.data_id, None)
-        (self._lru_dirty if dirty else self._lru_clean)[data.data_id] = data
+        with self._res_lock:
+            self._lru_clean.pop(data.data_id, None)
+            self._lru_dirty.pop(data.data_id, None)
+            (self._lru_dirty if dirty else self._lru_clean)[data.data_id] = data
 
     # ------------------------------------------------------------------
     # completion / stage_out / epilog
@@ -1090,30 +1414,44 @@ class TpuDevice(Device):
             # order them after the task's exec, which may have run on a
             # different (worker) thread (analysis/hb.py)
             pins.fire(pins.DEVICE_EPILOG_BEGIN, None, inflight.task)
-        for (pos, data), arr, so in zip(inflight.out_specs,
-                                        inflight.outputs,
-                                        inflight.out_hooks):
-            if so is not None:
-                # commit to THIS device: a hook building from host data
-                # would otherwise land on the process default device
-                arr = jax.device_put(so(arr, data, self), self.jdev)
-                self.stats["custom_stage_out"] = self.stats.get("custom_stage_out", 0) + 1
-            c = data.get_copy(self.data_index)
-            old = c.nbytes if c is not None else 0
-            if c is None:
-                c = data.attach_copy(self.data_index, arr)
-            else:
-                c.payload = arr
-            # the committed value is HOME-layout (stage_out already
-            # unpacked): a packed stage_in marker must not survive it
-            c.staged_by = None
-            self._hbm_realloc(data, old, arr.nbytes)
-            data.version_bump(self.data_index)
-            self._lru_touch(data, dirty=True)
-        # outputs grew residency: re-settle under the budget (zone mode
-        # already evicted during allocation)
-        if self._zone is None:
-            self._reserve(0)
+        with self._res_lock:
+            for (pos, data), arr, so in zip(inflight.out_specs,
+                                            inflight.outputs,
+                                            inflight.out_hooks):
+                if so is not None:
+                    # commit to THIS device: a hook building from host data
+                    # would otherwise land on the process default device
+                    arr = jax.device_put(so(arr, data, self), self.jdev)
+                    self.stats["custom_stage_out"] = self.stats.get("custom_stage_out", 0) + 1
+                c = data.get_copy(self.data_index)
+                old = c.nbytes if c is not None else 0
+                if c is None:
+                    c = data.attach_copy(self.data_index, arr)
+                else:
+                    c.payload = arr
+                # the committed value is HOME-layout (stage_out already
+                # unpacked): a packed stage_in marker must not survive it
+                c.staged_by = None
+                self._hbm_realloc(data, old, arr.nbytes)
+                data.version_bump(self.data_index)
+                self._lru_touch(data, dirty=True)
+            # outputs grew residency: re-settle under the budget (zone mode
+            # already evicted during allocation)
+            if self._zone is None:
+                self._reserve(0)
+        com = self._wb_committer()
+        if com is not None:
+            # tentpole (b): hand the just-committed outputs to the async
+            # committer OUTSIDE _res_lock (its capacity wait must not
+            # stall residency).  The committer dedups per data_id and
+            # drains on its byte watermark, so a tile rewritten by a
+            # later task commits its FINAL version once; the version
+            # guard drops anything superseded in flight.  A sticky
+            # committer error re-raises here and propagates to the
+            # caller's _fail_task_pool discipline: pool failure, not a
+            # hang (satellite 3).
+            for (_pos, data) in inflight.out_specs:
+                com.enqueue(data)
 
     # ------------------------------------------------------------------
     def data_advise(self, data: Data, advice: int) -> None:
@@ -1152,7 +1490,7 @@ class TpuDevice(Device):
         result and hands the buffer on — without this, every completed
         run's output stays dirty-resident until LRU pressure forces a
         full D2H write-back."""
-        with self._lock:
+        with self._lock, self._res_lock:
             self._lru_clean.pop(data.data_id, None)
             self._lru_dirty.pop(data.data_id, None)
             self._drop_copy(data, evicted=False)  # handed over, not evicted
@@ -1170,25 +1508,42 @@ class TpuDevice(Device):
         return total
 
     def detach(self) -> None:
-        # flush dirty tiles home so host-side readers see final data
-        for _, data in list(self._lru_dirty.items()):
-            self._writeback(data)
-        self._lru_dirty.clear()
-        self._lru_clean.clear()
-        # release residency ACCOUNTING with the LRUs: the payloads stay
-        # attached to their Data objects (a later stage-in reuses them,
-        # unaccounted — same rule as externally pre-placed copies), but a
-        # slot no LRU tracks can never be evicted, so leaving it charged
-        # would leak phantom hbm_used across device reuse (the shared
-        # `device=` amortization pattern) until eviction stops working
-        if self._zone is not None:
-            for (off, _nb) in self._offsets.values():
-                self._zone.release(off)
-            self._offsets.clear()
-            self.hbm_used = self._zone.used
-        else:
-            self._accounted.clear()
-            self.hbm_used = 0
+        # drain the async committer FIRST: its flush() barrier is what
+        # lets host-side readers (detach, redistribute, remote sends)
+        # see committed tiles.  A committer that died mid-run surfaces
+        # HERE, loudly — and is discarded so a shared device (the
+        # `device=` amortization pattern) gets a fresh one next run.
+        com = self._committer
+        if com is not None:
+            try:
+                com.flush()
+            except Exception:
+                self._committer = None
+                raise
+            com.close(flush=False)
+            self._committer = None
+        with self._res_lock:
+            # flush remaining dirty tiles home as ONE batched device->host
+            # get (satellite 2) — the version guard makes tiles the
+            # committer already landed a no-op, so each dirty tile
+            # commits exactly once
+            self._writeback_batch([d for _, d in list(self._lru_dirty.items())])
+            self._lru_dirty.clear()
+            self._lru_clean.clear()
+            # release residency ACCOUNTING with the LRUs: the payloads stay
+            # attached to their Data objects (a later stage-in reuses them,
+            # unaccounted — same rule as externally pre-placed copies), but a
+            # slot no LRU tracks can never be evicted, so leaving it charged
+            # would leak phantom hbm_used across device reuse (the shared
+            # `device=` amortization pattern) until eviction stops working
+            if self._zone is not None:
+                for (off, _nb) in self._offsets.values():
+                    self._zone.release(off)
+                self._offsets.clear()
+                self.hbm_used = self._zone.used
+            else:
+                self._accounted.clear()
+                self.hbm_used = 0
 
 
 def device_body(chore, fn):

@@ -221,44 +221,115 @@ def _pump_loop(ng, dev, pump_index: Dict[int, Any], stats: Dict[str, int],
     through :func:`..core.scheduling.retire_native` (COMPLETE_EXEC pins
     only), and ONE ``done_batch`` call runs every dep decrement /
     successor push / quiescence count natively.  Python cost is
-    O(batches), not O(tasks)."""
+    O(batches), not O(tasks).
+
+    When the device carries the staging pipeline (``stage_depth > 1``),
+    the pump keeps a WINDOW of up to ``stage_depth`` popped-but-not-yet
+    -submitted batches: each freshly popped batch's input tiles are
+    handed to the device's transfer lane (``prestage_batch``) the moment
+    it is popped, so batch N+1's host->device transfers overlap batch
+    N's compute (ROADMAP 5(b) double buffering).  To keep the window
+    meaningful when the whole ready frontier fits one ``pop_batch``, the
+    pop buffer shrinks to ``cap // stage_depth``: one wide ready wave
+    splits into ``stage_depth`` chunks and pipelines INTRA-wave.  A
+    prestage failure is non-fatal — the submit path restages the tile
+    synchronously and fails loudly if the data is truly bad."""
     import ctypes
+    from collections import deque
 
     from ..core import scheduling
     from ..data.data import land_into_home
 
     cap = max(1, _drain_batch())
-    buf = (ctypes.c_int64 * cap)()
+    depth = max(1, int(getattr(dev, "stage_depth", 1) or 1))
+    lane = None
+    if depth > 1 and hasattr(dev, "prestage_batch"):
+        from ..device.staging import StageLane
+        lane = StageLane(dev)
+    else:
+        depth = 1
+    chunk = max(1, cap // depth) if lane is not None else cap
+    free = deque((ctypes.c_int64 * chunk)() for _ in range(depth))
+    window: deque = deque()  # (buf, n, batch, stage_job|None)
     done = 0
-    while True:
-        n = ng.pop_batch(buf)
-        if n == 0:
+    try:
+        while True:
+            # fill the prefetch window: pop ready batches and kick their
+            # stage-in transfers before the oldest batch submits
+            while free and len(window) < depth:
+                buf = free.popleft()
+                n = ng.pop_batch(buf)
+                if n == 0:
+                    free.appendleft(buf)
+                    break
+                stats["pop_batches"] += 1
+                stats["pumped_tasks"] += n
+                batch = [pump_index[buf[i]] for i in range(n)]
+                if (lane is not None and not window and free and n >= 4
+                        and dev.prestage_bytes(batch)
+                        >= getattr(dev, "stage_split_bytes", 1 << 18)):
+                    # the whole ready frontier fit ONE buffer, the
+                    # window is otherwise idle, and there is REAL
+                    # transfer work to hide: re-slice the batch across
+                    # the free slots so the lane prestages slot k+1
+                    # while slot k computes.  Without the re-slice
+                    # every prestage completes before its own submit
+                    # starts and the double buffer degenerates to
+                    # synchronous staging; without the bytes gate the
+                    # split would shrink vmappable waves on dispatch-
+                    # bound runs for no transfer win.
+                    ids = [buf[i] for i in range(n)]
+                    bufs = [buf] + [free.popleft() for _ in range(depth - 1)]
+                    per = (n + len(bufs) - 1) // len(bufs)
+                    off = 0
+                    for b in bufs:
+                        k = min(per, n - off)
+                        if k <= 0:
+                            free.append(b)
+                            continue
+                        for i in range(k):
+                            b[i] = ids[off + i]
+                        sub = batch[off:off + k]
+                        off += k
+                        window.append((b, k, sub, lane.stage(sub)))
+                        stats["prefetched_batches"] += 1
+                    continue
+                job = None
+                if lane is not None:
+                    job = lane.stage(batch)
+                    stats["prefetched_batches"] += 1
+                window.append((buf, n, batch, job))
+            if not window:
+                why = _pump_failure(shims)
+                if why is not None:
+                    raise RuntimeError(f"native device run failed: {why}")
+                if ng.quiesced():
+                    break
+                raise RuntimeError(
+                    f"native pump stalled: ready queue empty with {done} "
+                    f"retired and {ng.sched_pending()} queued "
+                    "(cycle or missing commit?)")
+            buf, n, batch, job = window.popleft()
+            if job is not None:
+                job.wait()  # logs prestage errors; submit restages
+            dev.submit_batch(batch)
             why = _pump_failure(shims)
             if why is not None:
                 raise RuntimeError(f"native device run failed: {why}")
-            if ng.quiesced():
-                break
-            raise RuntimeError(
-                f"native pump stalled: ready queue empty with {done} "
-                f"retired and {ng.sched_pending()} queued "
-                "(cycle or missing commit?)")
-        stats["pop_batches"] += 1
-        stats["pumped_tasks"] += n
-        batch = [pump_index[buf[i]] for i in range(n)]
-        dev.submit_batch(batch)
-        why = _pump_failure(shims)
-        if why is not None:
-            raise RuntimeError(f"native device run failed: {why}")
-        for t in batch:
-            for (src, home) in t._wbs:
-                land_into_home(home, src.newest_copy().payload)
-        scheduling.retire_native(batch, dev)
-        done += ng.done_batch(buf, n)
-        stats["done_batches"] += 1
-        if retire_cb is not None:
-            retire_cb(batch)
-        if ev is not None:
-            stats["events_drained"] += ev.drain()
+            for t in batch:
+                for (src, home) in t._wbs:
+                    land_into_home(home, src.newest_copy().payload)
+            scheduling.retire_native(batch, dev)
+            done += ng.done_batch(buf, n)
+            stats["done_batches"] += 1
+            free.append(buf)
+            if retire_cb is not None:
+                retire_cb(batch)
+            if ev is not None:
+                stats["events_drained"] += ev.drain()
+    finally:
+        if lane is not None:
+            lane.close()
     if ev is not None:
         stats["events_drained"] += ev.drain()
     return done
@@ -303,7 +374,7 @@ class NativeExecutor:
         self.stats: Dict[str, int] = {
             "trampoline_entries": 0, "completion_callbacks": 0,
             "pop_batches": 0, "done_batches": 0, "pumped_tasks": 0,
-            "events_drained": 0}
+            "events_drained": 0, "prefetched_batches": 0}
         #: serve mode (NativeServeExecutor): build into ITS shared native
         #: graph under this tenant id instead of owning one
         self._shared_graph = _shared_graph
@@ -1129,7 +1200,7 @@ class NativeServeExecutor:
         self.stats: Dict[str, int] = {
             "trampoline_entries": 0, "completion_callbacks": 0,
             "pop_batches": 0, "done_batches": 0, "pumped_tasks": 0,
-            "events_drained": 0}
+            "events_drained": 0, "prefetched_batches": 0}
         self.children: List[NativeExecutor] = []
         self.retire_log: List[Tuple[int, int, float]] = []
         self._pos = 0

@@ -251,7 +251,13 @@ class RankTraceSet:
               # TRACING.md "hb event kinds")
               "hb_dep_dec", "hb_ver_bump", "hb_arena_alloc",
               "hb_arena_recycle", "hb_frame_send", "hb_frame_deliver",
-              "hb_task_done", "sched_publish")}
+              "hb_task_done", "sched_publish",
+              # staging-pipeline vocabulary (round 19): stage_in /
+              # writeback spans (event_id = batch span id, info =
+              # bytes) feed critpath's ``transfer`` bucket; the hb_*
+              # instants carry the pipeline's ordering edges
+              "stage_in", "writeback",
+              "hb_stage_in", "hb_wb_enqueue", "hb_wb_commit")}
             for t in self.traces]
         self._steals_seen: Dict[int, int] = {}
         self._subs: List[Any] = []
@@ -626,6 +632,49 @@ class RankTraceSet:
             lambda p: ((p["graph"] & 0x3FFFFF) << 40)
             | (p["task"] & 0xFFFFFFFFFF),
             lambda p: 1 if p["accepted"] else 0))
+
+        # staging-pipeline spans (device/staging.py, fired on the
+        # transfer lane / committer threads): event_id = the batch's
+        # process-wide span id so B/E pair up, info = bytes moved.  The
+        # critpath ``transfer`` bucket reads these spans.
+        def stage_cb(key, phase):
+            def cb(es, p):
+                p = p or {}
+                tr = self._trace_of(p.get("rank", self.base_rank))
+                if tr is None:
+                    tr = self.traces[0]
+                getattr(tr, phase)(
+                    self._k[tr.rank - self.base_rank][key],
+                    int(p.get("id", 0)) & 0x7FFFFFFFFFFFFFFF,
+                    int(p.get("bytes", 0)))
+            return cb
+
+        sub(pins.STAGE_IN_BEGIN, stage_cb("stage_in", "begin"))
+        sub(pins.STAGE_IN_END, stage_cb("stage_in", "end"))
+        sub(pins.WRITEBACK_BEGIN, stage_cb("writeback", "begin"))
+        sub(pins.WRITEBACK_END, stage_cb("writeback", "end"))
+
+        # staging-pipeline hb edges: hb_stage_in's event_id is the TASK
+        # token (same space as the exec spans, so the offline analyzer
+        # joins stage_in -> exec); wb enqueue/commit carry the
+        # committer's ticket (commit fires once per drained batch with
+        # the whole ticket list)
+        sub(pins.HB_STAGE_IN, hb_cb(
+            "hb_stage_in", lambda p: self._tok(p["task"])))
+
+        def on_wb_hb(es, p):
+            p = p or {}
+            tr = self.traces[0]
+            ks = self._k[tr.rank - self.base_rank]
+            if "ticket" in p:
+                tr.instant(ks["hb_wb_enqueue"],
+                           int(p["ticket"]) & 0x7FFFFFFFFFFFFFFF)
+            for t in p.get("tickets") or ():
+                tr.instant(ks["hb_wb_commit"],
+                           int(t) & 0x7FFFFFFFFFFFFFFF)
+
+        sub(pins.HB_WB_ENQUEUE, on_wb_hb)
+        sub(pins.HB_WB_COMMIT, on_wb_hb)
         return self
 
     def uninstall(self) -> None:

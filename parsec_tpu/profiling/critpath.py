@@ -49,6 +49,10 @@ COMPILE_SPAN_NAMES = ("compile",)
 #: runtime-collective span names that count as collective time in gap
 #: attribution (comm/coll.py fires them; binary traces record them)
 COLL_SPAN_NAMES = ("coll",)
+#: staging-pipeline span names that count as host<->device transfer
+#: time in gap attribution (device/staging.py fires them around
+#: prefetch stage-in and deferred write-back batches)
+TRANSFER_SPAN_NAMES = ("stage_in", "writeback")
 
 #: workload labels: task-class names (exact, or by prefix) aggregate
 #: into a ``per_label`` section next to ``per_class`` — e.g. every
@@ -108,6 +112,7 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
             comm_names: Sequence[str] = COMM_SPAN_NAMES,
             compile_names: Sequence[str] = COMPILE_SPAN_NAMES,
             coll_names: Sequence[str] = COLL_SPAN_NAMES,
+            transfer_names: Sequence[str] = TRANSFER_SPAN_NAMES,
             job=None, straggler_factor: Optional[float] = None,
             straggler_min_samples: Optional[int] = None) -> dict:
     """Reconstruct the dependency critical path and attribute its wall
@@ -115,7 +120,7 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
 
         {"wall_us", "n_tasks", "coverage",
          "buckets": {"compute_us", "comm_us", "coll_us", "compile_us",
-                     "host_gap_us"},
+                     "transfer_us", "host_gap_us"},
          "per_class": {cls: {"count", "compute_us", "comm_us", "coll_us",
                              "compile_us", "host_gap_us"}},
          "chain": [{"token", "pid", "class", "begin_us", "end_us",
@@ -168,6 +173,11 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
     # on whichever comm callback completed the op
     coll_open: Dict[Tuple[Any, Any, str], float] = {}
     coll_iv: Dict[Any, List[Tuple[float, float]]] = defaultdict(list)
+    # staging spans pair B/E by event_id (the batch's process-wide span
+    # id) like collectives: the committer thread ends what it began,
+    # but the id pairing stays robust across lane/committer/detach
+    transfer_open: Dict[Tuple[Any, Any, str], float] = {}
+    transfer_iv: Dict[Any, List[Tuple[float, float]]] = defaultdict(list)
     #: protocol-regime accounting from the tagged payload instants
     #: (comm_recv_eager / comm_recv_rdv, profiling.binary): events +
     #: bytes per wire regime, so comm time on the chain can be read
@@ -237,6 +247,14 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
                 b = coll_open.pop(ckey, None)
                 if b is not None:
                     coll_iv[pid].append((b, e["ts"]))
+        elif name in transfer_names:
+            ckey = (pid, args.get("event_id"), name)
+            if ph == "B":
+                transfer_open[ckey] = e["ts"]
+            elif ph == "E":
+                b = transfer_open.pop(ckey, None)
+                if b is not None:
+                    transfer_iv[pid].append((b, e["ts"]))
 
     # fusion summary over the WHOLE trace (not just the chain): every
     # fused dispatch is one device enqueue standing in for N member
@@ -255,7 +273,7 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
     empty = {"wall_us": 0.0, "n_tasks": 0, "coverage": 0.0,
              "buckets": {"compute_us": 0.0, "comm_us": 0.0,
                          "coll_us": 0.0, "compile_us": 0.0,
-                         "host_gap_us": 0.0},
+                         "transfer_us": 0.0, "host_gap_us": 0.0},
              "per_class": {}, "per_label": {}, "per_tenant": {},
              "per_job": {}, "chain": [], "comm_regimes": regimes,
              "fused": fused_summary, "stragglers": stragglers,
@@ -273,6 +291,8 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
                       for pid, iv in compile_iv.items()}
     coll_merged = {pid: _merge_intervals(iv)
                    for pid, iv in coll_iv.items()}
+    transfer_merged = {pid: _merge_intervals(iv)
+                       for pid, iv in transfer_iv.items()}
 
     # backward walk from the last-finishing task: at each step pick the
     # predecessor that finished last (the binding one)
@@ -289,16 +309,19 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
     chain.reverse()
 
     buckets = {"compute_us": 0.0, "comm_us": 0.0, "coll_us": 0.0,
-               "compile_us": 0.0, "host_gap_us": 0.0}
+               "compile_us": 0.0, "transfer_us": 0.0, "host_gap_us": 0.0}
     per_class: Dict[str, Dict[str, float]] = defaultdict(
         lambda: {"count": 0, "compute_us": 0.0, "comm_us": 0.0,
-                 "coll_us": 0.0, "compile_us": 0.0, "host_gap_us": 0.0})
+                 "coll_us": 0.0, "compile_us": 0.0, "transfer_us": 0.0,
+                 "host_gap_us": 0.0})
     per_tenant: Dict[str, Dict[str, float]] = defaultdict(
         lambda: {"count": 0, "compute_us": 0.0, "comm_us": 0.0,
-                 "coll_us": 0.0, "compile_us": 0.0, "host_gap_us": 0.0})
+                 "coll_us": 0.0, "compile_us": 0.0, "transfer_us": 0.0,
+                 "host_gap_us": 0.0})
     per_job: Dict[str, Dict[str, float]] = defaultdict(
         lambda: {"count": 0, "compute_us": 0.0, "comm_us": 0.0,
-                 "coll_us": 0.0, "compile_us": 0.0, "host_gap_us": 0.0})
+                 "coll_us": 0.0, "compile_us": 0.0, "transfer_us": 0.0,
+                 "host_gap_us": 0.0})
     rows = []
     prev_end: Optional[float] = None
     for key in chain:
@@ -313,26 +336,35 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
                             coll_merged.get(pid, ()))
         gap_compile = _overlap(t["begin"] - gap, t["begin"],
                                compile_merged.get(pid, ()))
-        # comm/coll/compile windows can overlap the same gap (a manager
-        # compiling while a frame drains, a collective streaming over
-        # the transport it is itself a span above): never attribute a
-        # microsecond twice — each later bucket is capped by what the
-        # earlier ones left over (comm wins, then coll, then compile)
+        gap_transfer = _overlap(t["begin"] - gap, t["begin"],
+                                transfer_merged.get(pid, ()))
+        # comm/coll/compile/transfer windows can overlap the same gap (a
+        # manager compiling while a frame drains, a collective streaming
+        # over the transport it is itself a span above, a stage-in batch
+        # racing the committer): never attribute a microsecond twice —
+        # each later bucket is capped by what the earlier ones left over
+        # (comm wins, then coll, then compile, then transfer)
         gap_coll = min(gap_coll, max(0.0, gap - gap_comm))
         gap_compile = min(gap_compile,
                           max(0.0, gap - gap_comm - gap_coll))
+        gap_transfer = min(gap_transfer,
+                           max(0.0, gap - gap_comm - gap_coll
+                               - gap_compile))
+        attributed_gap = gap_comm + gap_coll + gap_compile + gap_transfer
         buckets["compute_us"] += dur
         buckets["comm_us"] += gap_comm
         buckets["coll_us"] += gap_coll
         buckets["compile_us"] += gap_compile
-        buckets["host_gap_us"] += gap - gap_comm - gap_coll - gap_compile
+        buckets["transfer_us"] += gap_transfer
+        buckets["host_gap_us"] += gap - attributed_gap
         pc = per_class[cls]
         pc["count"] += 1
         pc["compute_us"] += dur
         pc["comm_us"] += gap_comm
         pc["coll_us"] += gap_coll
         pc["compile_us"] += gap_compile
-        pc["host_gap_us"] += gap - gap_comm - gap_coll - gap_compile
+        pc["transfer_us"] += gap_transfer
+        pc["host_gap_us"] += gap - attributed_gap
         tenant = tenants.get(key)
         if tenant is not None:
             pt = per_tenant[tenant]
@@ -341,7 +373,8 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
             pt["comm_us"] += gap_comm
             pt["coll_us"] += gap_coll
             pt["compile_us"] += gap_compile
-            pt["host_gap_us"] += gap - gap_comm - gap_coll - gap_compile
+            pt["transfer_us"] += gap_transfer
+            pt["host_gap_us"] += gap - attributed_gap
         tid = token_to_job.get(key)
         if tid is not None:
             pj = per_job[hex_id(tid)]
@@ -350,14 +383,16 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
             pj["comm_us"] += gap_comm
             pj["coll_us"] += gap_coll
             pj["compile_us"] += gap_compile
-            pj["host_gap_us"] += gap - gap_comm - gap_coll - gap_compile
+            pj["transfer_us"] += gap_transfer
+            pj["host_gap_us"] += gap - attributed_gap
         rows.append({"token": tok, "pid": pid, "class": cls,
                      "tenant": tenant,
                      "trace_id": hex_id(tid) if tid is not None else None,
                      "begin_us": t["begin"], "end_us": t["end"],
                      "gap_us": gap, "gap_comm_us": gap_comm,
                      "gap_coll_us": gap_coll,
-                     "gap_compile_us": gap_compile})
+                     "gap_compile_us": gap_compile,
+                     "gap_transfer_us": gap_transfer})
         prev_end = max(t["end"], prev_end or t["end"])
     wall = tasks[chain[-1]]["end"] - tasks[chain[0]]["begin"]
     attributed = sum(buckets.values())
@@ -370,7 +405,8 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
             continue
         agg = per_label.setdefault(
             lab, {"count": 0, "compute_us": 0.0, "comm_us": 0.0,
-                  "coll_us": 0.0, "compile_us": 0.0, "host_gap_us": 0.0})
+                  "coll_us": 0.0, "compile_us": 0.0, "transfer_us": 0.0,
+                  "host_gap_us": 0.0})
         for key in agg:
             agg[key] += pc[key]
     # job phase attribution: the serve-fired job_phase instants bound
@@ -465,7 +501,7 @@ def render(report: dict) -> str:
             f"-> drain {_ms(ph['drain_us'])} ms  (total "
             f"{_ms(ph['total_us'])} ms)")
     for k in ("compute_us", "comm_us", "coll_us", "compile_us",
-              "host_gap_us"):
+              "transfer_us", "host_gap_us"):
         frac = b.get(k, 0.0) / wall if wall > 0 else 0.0
         lines.append(f"  {k[:-3]:<10} {b.get(k, 0.0) / 1e3:>10.3f} ms"
                      f"  {frac:>6.1%}")

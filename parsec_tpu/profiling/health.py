@@ -126,7 +126,32 @@ def _device_summary(dev) -> Dict[str, Any]:
         "wave_occupancy": (s.get("wave_tasks", 0) / waves) if waves else 0.0,
         "bytes_in": int(s.get("bytes_in", 0)),
         "bytes_out": int(s.get("bytes_out", 0)),
+        # staging pipeline (round 19): prefetched tile count, batched
+        # put/get activity and the async committer's live queue state —
+        # zeros with the pipeline off (stage_depth=1) or on devices
+        # without one
+        "staging": _staging_summary(dev),
     }
+
+
+def _staging_summary(dev) -> Dict[str, Any]:
+    s = getattr(dev, "stats", {})
+    com = getattr(dev, "_committer", None)
+    out = {
+        "depth": int(getattr(dev, "stage_depth", 1) or 1),
+        "prefetched_tiles": int(s.get("prefetched_tiles", 0)),
+        "batched_puts": int(s.get("stage_batched_puts", 0)),
+        "batched_put_tiles": int(s.get("stage_batched_tiles", 0)),
+        "wb_batches": int(s.get("wb_batches", 0)),
+        "wb_pending": 0, "wb_pending_bytes": 0,
+        "wb_committed": 0, "wb_dropped_stale": 0,
+    }
+    if com is not None:
+        out["wb_pending"] = int(com.pending())
+        out["wb_pending_bytes"] = int(com.pending_bytes())
+        out["wb_committed"] = int(com.stats.get("committed", 0))
+        out["wb_dropped_stale"] = int(com.stats.get("dropped_stale", 0))
+    return out
 
 
 def context_status(ctx) -> Dict[str, Any]:
@@ -230,6 +255,21 @@ def register_context_gauges(ctx) -> Callable[[], None]:
     gauge(sde.DEVICE_TASKS_EXECUTED,
           lambda: float(sum(int(d.stats.get("executed_tasks", 0))
                             for d in ctx.devices)))
+
+    # staging-pipeline gauges (device/staging.py): prefetched tiles +
+    # the async write-back committer's live queue — zeros with the
+    # pipeline off, registered unconditionally so the doc'd set is live
+    def staging_val(key: str):
+        def get() -> float:
+            return float(sum(int(_staging_summary(d).get(key, 0))
+                             for d in ctx.devices))
+        return get
+
+    gauge(sde.DEVICE_STAGE_PREFETCHED, staging_val("prefetched_tiles"))
+    gauge(sde.DEVICE_WRITEBACKS_PENDING, staging_val("wb_pending"))
+    gauge(sde.DEVICE_WRITEBACKS_COMMITTED, staging_val("wb_committed"))
+    gauge(sde.DEVICE_WRITEBACKS_DROPPED_STALE,
+          staging_val("wb_dropped_stale"))
 
     # executable-cache counters (compile_cache.ExecutableCache.stats):
     # cache effectiveness + the compile-once-ship-serialized channel
@@ -428,6 +468,22 @@ def prometheus_text(ctx) -> str:
               d["wave_occupancy"])
         _line(out, "parsec_device_tasks_executed_total", lab,
               d["executed_tasks"])
+        st = d.get("staging") or {}
+        if st:
+            _line(out, "parsec_device_staging_depth", lab,
+                  st.get("depth", 1))
+            _line(out, "parsec_device_staging_prefetched_tiles_total",
+                  lab, st.get("prefetched_tiles", 0))
+            _line(out, "parsec_device_staging_batched_puts_total", lab,
+                  st.get("batched_puts", 0))
+            _line(out, "parsec_device_staging_wb_pending", lab,
+                  st.get("wb_pending", 0))
+            _line(out, "parsec_device_staging_wb_pending_bytes", lab,
+                  st.get("wb_pending_bytes", 0))
+            _line(out, "parsec_device_staging_wb_committed_total", lab,
+                  st.get("wb_committed", 0))
+            _line(out, "parsec_device_staging_wb_dropped_stale_total",
+                  lab, st.get("wb_dropped_stale", 0))
 
     cc = doc.get("compile_cache")
     if cc is not None:
@@ -908,10 +964,21 @@ class Watchdog:
              int(getattr(tp.tdm, "_nb_tasks", -1) or 0),
              int(getattr(tp.tdm, "_runtime_actions", -1) or 0))
             for tp in self._active_pools()))
+        # async write-back committer drain progress: drained() (committed
+        # + dropped-stale) advances whenever the committer lands a batch,
+        # so a run blocked on flush() still shows progress while the
+        # queue drains — and a WEDGED committer (pending > 0, drained
+        # static) lets the stall be declared and diagnosed (OBS011)
+        # instead of hanging silently
+        wb = 0
+        for d in ctx.devices:
+            com = getattr(d, "_committer", None)
+            if com is not None:
+                wb += int(com.drained())
         # NB: a fourcounter's probing waves are deliberately NOT part of
         # the epoch — an unconcludable wave repeats forever on a wedged
         # mesh; its counter transitions surface through the pool tuples
-        return (executed, dev, frames, self._exec_begins, pools)
+        return (executed, dev, frames, self._exec_begins, wb, pools)
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "Watchdog":
@@ -1162,6 +1229,28 @@ class Watchdog:
                         "OBS004",
                         f"rank {peer}: last heartbeat "
                         f"{now - heard:.1f}s ago"))
+
+        # wedged async write-back committer (OBS011): deferred commits
+        # pending but the drain counter is static (the epoch tuple
+        # carries drained(), so pending-with-progress never lands here —
+        # diagnose only runs once the WHOLE epoch froze)
+        for d in ctx.devices:
+            com = getattr(d, "_committer", None)
+            if com is None:
+                continue
+            pending = int(com.pending())
+            if pending > 0 or not com.healthy:
+                state = "dead" if not com.healthy else "wedged"
+                err = getattr(com, "error", None)
+                findings.append(Finding(
+                    "OBS011",
+                    f"device {d.name}: async write-back committer "
+                    f"{state} with {pending} deferred commit(s) "
+                    f"pending ({int(com.pending_bytes())} bytes; "
+                    f"{int(com.drained())} drained so far"
+                    + (f"; error: {err!r}" if err is not None else "")
+                    + ") — detach()/flush() would block until the "
+                      "capacity timeout", count=pending))
 
         # SLO plane: breached per-tenant p95 targets (OBS009) and
         # straggling (class, rank) pairs incl. late heartbeaters

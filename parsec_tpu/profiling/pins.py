@@ -96,6 +96,27 @@ JOB_DONE = "job_done"
 # profiling.critpath can attribute critical-path time to compilation.
 COMPILE_BEGIN = "compile_begin"
 COMPILE_END = "compile_end"
+# staging-pipeline spans (device/staging.py): one begin/end pair per
+# host->device prefetch batch (STAGE_IN, fired on the transfer lane)
+# and per device->host commit batch (WRITEBACK, fired on the committer
+# thread or around a batched detach flush).  Payload {"rank","id",
+# "tiles","bytes"} (+ "seconds" on END).  Recorded as ``stage_in`` /
+# ``writeback`` spans in binary traces; profiling.critpath attributes
+# gap time under them to the ``transfer`` bucket.
+STAGE_IN_BEGIN = "stage_in_begin"
+STAGE_IN_END = "stage_in_end"
+WRITEBACK_BEGIN = "writeback_begin"
+WRITEBACK_END = "writeback_end"
+# happens-before edges of the async staging pipeline (analysis/hb.py):
+# HB_STAGE_IN fires on the TRANSFER thread after a task's inputs are
+# prestaged, payload {"task": task} — publishes the transfer clock into
+# the task's token so stage_in happens-before exec; HB_WB_ENQUEUE fires
+# on the thread that committed the epilog (payload {"ticket"}) and
+# HB_WB_COMMIT on the committer thread when that deferred write-back
+# lands (payload {"tickets": [...]}) — exec happens-before commit.
+HB_STAGE_IN = "hb_stage_in"
+HB_WB_ENQUEUE = "hb_wb_enqueue"
+HB_WB_COMMIT = "hb_wb_commit"
 
 ALL_SITES = [v for k, v in list(globals().items()) if k.isupper() and isinstance(v, str)]
 

@@ -37,6 +37,13 @@ ARENA_BYTES_IN_USE = "PARSEC::ARENA::BYTES_IN_USE"
 ARENA_BYTES_HIGH_WATER = "PARSEC::ARENA::BYTES_HIGH_WATER"
 DEVICE_WAVE_OCCUPANCY = "PARSEC::DEVICE::WAVE_OCCUPANCY"
 DEVICE_TASKS_EXECUTED = "PARSEC::DEVICE::TASKS_EXECUTED"
+# staging-pipeline gauges (device/staging.py + TpuDevice stats — the
+# async host<->device pipeline of round 19: prefetched tiles, the
+# deferred write-back queue's depth and drain progress)
+DEVICE_STAGE_PREFETCHED = "PARSEC::DEVICE::STAGE_PREFETCHED"
+DEVICE_WRITEBACKS_PENDING = "PARSEC::DEVICE::WRITEBACKS_PENDING"
+DEVICE_WRITEBACKS_COMMITTED = "PARSEC::DEVICE::WRITEBACKS_COMMITTED"
+DEVICE_WRITEBACKS_DROPPED_STALE = "PARSEC::DEVICE::WRITEBACKS_DROPPED_STALE"
 # executable-cache counters (compile_cache.py; per-context caches are
 # surfaced as gauges by profiling.health.register_context_gauges)
 COMPILE_CACHE_HITS = "PARSEC::COMPILE::CACHE_HITS"

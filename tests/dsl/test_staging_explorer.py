@@ -1,0 +1,174 @@
+"""Staging pipeline x the native pump: explorer digests + hb-check.
+
+The acceptance leg for the round-19 async pipeline (satellite 4): the
+prefetch window and deferred write-backs must be invisible to numerics
+— 4 explorer seeds x {dpotrf device chores, flash attention} x
+``runtime_stage_depth`` in {1, 2, 4} land bit-identical results — and
+hb-check stays clean with the new staging events in the trace
+(stage_in happens-before exec, exec happens-before write-back commit).
+
+Wave batching is off in the digest legs: wave composition is
+schedule-dependent and vmapped kernels need not be bitwise equal to
+singles (same discipline as tests/dsl/test_native_pump.py).
+"""
+
+import numpy as np
+import pytest
+
+from parsec_tpu import native
+from parsec_tpu.utils import mca_param
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native core unavailable: {native.build_error()}")
+
+EXPLORER_SEEDS = (0, 1, 7, 42)  # the 4 tier-1 seeds
+DEPTHS = (1, 2, 4)  # off / double-buffered default / deep window
+
+
+def _set(framework, name, value):
+    mca_param.params.set(framework, name, value)
+
+
+def _unset(framework, name):
+    mca_param.params.unset(framework, name)
+
+
+def _spd(n, seed=0):
+    rng = np.random.default_rng(seed)
+    M = rng.standard_normal((n, n))
+    return M @ M.T + n * np.eye(n)
+
+
+def _dpotrf_device_tp(n, nb, seed=0):
+    from parsec_tpu.datadist import TiledMatrix
+    from parsec_tpu.ops.cholesky import cholesky_ptg
+
+    S = _spd(n, seed=seed)
+    A = TiledMatrix(n, n, nb, nb, name="A", dtype=np.float64).from_array(S)
+    tp = cholesky_ptg(use_tpu=True, use_cpu=False).taskpool(NT=A.mt, A=A)
+    return S, A, tp
+
+
+def _pump_run(tp, seed, depth):
+    from parsec_tpu.dsl.native_exec import run_native
+
+    _set("sched", "rnd_seed", seed)
+    _set("runtime", "stage_depth", depth)
+    try:
+        run_native(tp, native_device=True)
+    finally:
+        _unset("runtime", "stage_depth")
+        _unset("sched", "rnd_seed")
+
+
+def test_explorer_dpotrf_digests_identical_across_stage_depths():
+    """4 seeds x 3 depths on the dpotrf device DAG: every combination
+    lands the bit-identical factor the depth-1 (synchronous) baseline
+    does — prefetch races and deferred commits never leak into tiles."""
+    from parsec_tpu.analysis.schedules import tile_digest
+
+    _set("device", "tpu_wave_batch", 0)
+    try:
+        ref = None
+        for depth in DEPTHS:
+            for seed in EXPLORER_SEEDS:
+                S, A, tp = _dpotrf_device_tp(96, 24, seed=11)
+                _pump_run(tp, seed, depth)
+                d = tile_digest(A)
+                if ref is None:
+                    ref = d
+                assert d == ref, \
+                    f"digest diverged at depth={depth} seed={seed}"
+    finally:
+        _unset("device", "tpu_wave_batch")
+
+
+def test_explorer_attention_digests_identical_across_stage_depths():
+    """Same grid on the attention carry chain: the online-softmax
+    accumulation is order-sensitive along the chain, so a pipeline that
+    reordered or tore a carry tile would show up bitwise."""
+    from parsec_tpu.ops.attention import build_flash_attention
+
+    rng = np.random.default_rng(9)
+    q = rng.standard_normal((1, 48, 2, 16)).astype(np.float32)
+    k = rng.standard_normal((1, 48, 2, 16)).astype(np.float32)
+    v = rng.standard_normal((1, 48, 2, 16)).astype(np.float32)
+
+    _set("device", "tpu_wave_batch", 0)
+    try:
+        ref = None
+        for depth in DEPTHS:
+            for seed in EXPLORER_SEEDS:
+                tp, assemble = build_flash_attention(
+                    q, k, v, causal=True, q_block=16, kv_block=16,
+                    use_cpu=False)
+                _pump_run(tp, seed, depth)
+                out = assemble()
+                if ref is None:
+                    ref = out
+                np.testing.assert_array_equal(
+                    out, ref,
+                    err_msg=f"attention diverged depth={depth} seed={seed}")
+    finally:
+        _unset("device", "tpu_wave_batch")
+
+
+def test_pump_hbcheck_clean_with_staging_events():
+    """hb-check over a depth-2 pump run: the trace carries the new
+    staging events (prestage release, write-back enqueue/commit pairs)
+    and the analysis still certifies the run — stage_in happens-before
+    exec, exec happens-before the deferred commit."""
+    from parsec_tpu.analysis.hb import HBRecorder
+    from parsec_tpu.dsl.native_exec import run_native
+
+    S, A, tp = _dpotrf_device_tp(96, 24, seed=4)
+    _set("runtime", "stage_depth", 2)
+    _set("runtime", "wb_window_mb", 1)
+    try:
+        with HBRecorder(stacks=False) as rec:
+            ran = run_native(tp, native_device=True)
+    finally:
+        _unset("runtime", "wb_window_mb")
+        _unset("runtime", "stage_depth")
+    assert ran == 20
+    kinds = {}
+    for ev in rec.events:
+        kinds[ev.kind] = kinds.get(ev.kind, 0) + 1
+    assert kinds.get("stage_in", 0) > 0, "prestage left no hb events"
+    assert kinds.get("wb_enqueue", 0) > 0
+    assert kinds.get("wb_commit", 0) > 0
+    assert kinds.get("task_done") == 20
+    assert rec.analyze() == []
+    L = np.tril(A.to_array())
+    np.testing.assert_allclose(L @ L.T, S, rtol=1e-10, atol=1e-10)
+
+
+def test_pump_prefetch_window_engages():
+    """Depth 2 arms the transfer lane: the pump reports prefetched
+    batches and the device counts prestaged tiles; depth 1 keeps the
+    legacy synchronous shape (no lane, no committer)."""
+    from parsec_tpu.dsl.native_exec import NativeExecutor
+
+    def run(depth):
+        S, A, tp = _dpotrf_device_tp(128, 16, seed=2)
+        _set("runtime", "stage_depth", depth)
+        try:
+            ex = NativeExecutor(tp, native_device=True)
+            ran = ex.run(nthreads=2)
+            stats = dict(ex.stats)
+            dstats = dict(ex.device.stats)
+            ex.close()
+        finally:
+            _unset("runtime", "stage_depth")
+        assert ran == 120
+        L = np.tril(A.to_array())
+        np.testing.assert_allclose(L @ L.T, S, rtol=1e-10, atol=1e-10)
+        return stats, dstats
+
+    stats_on, dstats_on = run(2)
+    assert stats_on["prefetched_batches"] > 0
+    assert dstats_on.get("prefetched_tiles", 0) > 0
+    stats_off, dstats_off = run(1)
+    assert stats_off["prefetched_batches"] == 0
+    assert dstats_off.get("prefetched_tiles", 0) == 0

@@ -185,6 +185,10 @@ def test_sde_doc_drift_after_dpotrf(clean_sde):
             sde.FUSION_DISPATCH_SAVED} <= documented
     # ...and the SLO-plane gauge set (PR 15)
     assert {sde.SLO_VIOLATIONS, sde.SLO_STRAGGLER_RANKS} <= documented
+    # ...and the staging-pipeline gauge set (round 19)
+    assert {sde.DEVICE_STAGE_PREFETCHED, sde.DEVICE_WRITEBACKS_PENDING,
+            sde.DEVICE_WRITEBACKS_COMMITTED,
+            sde.DEVICE_WRITEBACKS_DROPPED_STALE} <= documented
 
     n, nb = 64, 16
     rng = np.random.default_rng(5)

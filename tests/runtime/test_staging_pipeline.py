@@ -1,0 +1,452 @@
+"""Asynchronous staging pipeline — dynamic-runtime and unit coverage.
+
+The round-19 pipeline (``parsec_tpu/device/staging.py``) defers dirty
+write-backs to a background committer and batches host<->device
+transfers.  These tests pin the correctness contracts the design rests
+on:
+
+* the :class:`WritebackCommitter` unit surface against a stub device —
+  per-tile dedup, the drain watermark, ``wait_for``, and the STICKY
+  failure discipline (a dead committer fails enqueuers and ``flush``,
+  it never hangs them);
+* ``detach()`` after async write-backs commits every dirty tile home
+  EXACTLY once — tiles the committer already landed are version-guard
+  dropped by the sync flush (no double commit, no stale rollback);
+* custom ``stage_in``/``stage_out`` hooks compose with the deferred
+  path: a packed device copy is never flushed home (the home-layout
+  host copy already carries the version) and numerics stay exact;
+* a committer death surfaces as a POOL failure through the epilog
+  enqueue, not a hang;
+* LRU eviction routes its write-back through the committer
+  (``runtime_stage_depth`` >= 2) and data survives budget pressure;
+* the dynamic runtime's tile digests are bit-identical with the
+  pipeline on vs off.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parsec_tpu import Context, DEV_TPU
+from parsec_tpu.data import data_create
+from parsec_tpu.device.staging import WritebackCommitter
+from parsec_tpu.dsl import DTDTaskpool, INOUT
+from parsec_tpu.utils import mca_param
+
+
+def _set(framework, name, value):
+    mca_param.params.set(framework, name, value)
+
+
+def _unset(framework, name):
+    mca_param.params.unset(framework, name)
+
+
+@pytest.fixture
+def ctx():
+    c = Context(nb_cores=2)
+    yield c
+    c.fini()
+
+
+def tpu_dev(ctx):
+    for d in ctx.devices:
+        if d.mca_name == "tpu":
+            return d
+    pytest.skip("no jax device available")
+
+
+# ---------------------------------------------------------------------------
+# WritebackCommitter unit surface (stub device)
+# ---------------------------------------------------------------------------
+
+class _StubDev:
+    """The exact surface the committer drives: name for the thread,
+    data_index for dirty-copy lookup, snapshot/D2H/commit halves."""
+
+    name = "stub"
+    data_index = 1
+    context = None
+
+    def __init__(self):
+        self.commits = []  # (data_id, version) in commit order
+        self.fail: BaseException = None
+        self.d2h_calls = 0
+
+    def _wb_snapshot(self, data):
+        with data.lock:
+            c = data.get_copy(self.data_index)
+            if c is None or c.payload is None:
+                return None
+            hc = data.get_copy(0)
+            if hc is not None and hc.payload is not None \
+                    and hc.version >= c.version:
+                return None
+            return (c.payload, c.version)
+
+    def _d2h_batch(self, payloads):
+        self.d2h_calls += 1
+        if self.fail is not None:
+            raise self.fail
+        return [np.asarray(p) for p in payloads]
+
+    def _commit_host(self, data, version, host):
+        with data.lock:
+            hc = data.get_copy(0)
+            if hc is not None and hc.payload is not None \
+                    and hc.version >= version:
+                return False
+            hc = data.attach_copy(0, host)
+            hc.version = version
+        self.commits.append((data.data_id, version))
+        return True
+
+
+def _dirty(key, value, version=2, n=16):
+    """A Data whose device copy (index 1) is ``version`` ahead of the
+    host copy — exactly what an epilog leaves behind."""
+    d = data_create(key, payload=np.zeros(n))
+    c = d.attach_copy(1, np.full(n, float(value)))
+    c.version = version
+    return d
+
+
+def test_committer_dedup_commits_newest_version_once():
+    dev = _StubDev()
+    com = WritebackCommitter(dev)
+    try:
+        d = _dirty("a", 1.0, version=2)
+        t1 = com.enqueue(d)
+        # re-dirty while pending: the dedup keeps ONE entry, the
+        # snapshot at drain time sees the newest version
+        with d.lock:
+            d.get_copy(1).payload = np.full(16, 9.0)
+            d.get_copy(1).version = 3
+        t2 = com.enqueue(d)
+        assert t2 > t1
+        assert com.stats["enqueued"] == 2
+        assert com.pending() == 1
+        com.flush()
+        assert dev.commits == [(d.data_id, 3)]
+        np.testing.assert_allclose(np.asarray(d.get_copy(0).payload), 9.0)
+        assert com.stats["committed"] == 1
+    finally:
+        com.close(flush=False)
+
+
+def test_committer_watermark_defers_below_window():
+    """Small dirty bytes sit pending (no eager D2H flood); the flush
+    barrier drains them."""
+    dev = _StubDev()
+    com = WritebackCommitter(dev)  # default window: 32 MB
+    try:
+        ds = [_dirty(i, float(i)) for i in range(4)]
+        for d in ds:
+            com.enqueue(d)
+        time.sleep(0.4)  # > the committer's poll interval
+        assert com.pending() == 4  # watermark not crossed: nothing drained
+        assert dev.d2h_calls == 0
+        com.flush()
+        assert com.pending() == 0
+        assert com.stats["committed"] == 4
+        assert com.drained() == 4
+    finally:
+        com.close(flush=False)
+
+
+def test_committer_wait_for_drains_one_tile():
+    dev = _StubDev()
+    com = WritebackCommitter(dev)
+    try:
+        d = _dirty("v", 5.0)
+        com.enqueue(d)
+        assert com.wait_for(d.data_id, timeout=30.0)
+        np.testing.assert_allclose(np.asarray(d.get_copy(0).payload), 5.0)
+    finally:
+        com.close(flush=False)
+
+
+def test_committer_stale_entry_dropped_not_committed():
+    """Host already at (or past) the device version: the version guard
+    drops the entry — a deferred commit can never roll a tile back."""
+    dev = _StubDev()
+    com = WritebackCommitter(dev)
+    try:
+        d = _dirty("s", 7.0, version=2)
+        d.get_copy(0).version = 5  # host moved past the device copy
+        com.enqueue(d)
+        com.flush()
+        assert dev.commits == []
+        assert com.stats["dropped_stale"] == 1
+    finally:
+        com.close(flush=False)
+
+
+def test_committer_failure_is_sticky_and_loud():
+    """A D2H failure kills the committer; the stored error re-raises on
+    the next enqueue AND on flush — callers fail, they don't hang."""
+    dev = _StubDev()
+    dev.fail = RuntimeError("injected D2H loss")
+    com = WritebackCommitter(dev)
+    try:
+        com.enqueue(_dirty("f0", 1.0))
+        com.kick()
+        deadline = time.monotonic() + 30
+        while com.error is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert com.error is not None
+        assert not com.healthy
+        with pytest.raises(RuntimeError, match="committer failed"):
+            com.enqueue(_dirty("f1", 2.0))
+        with pytest.raises(RuntimeError, match="committer failed"):
+            com.flush()
+    finally:
+        com.close(flush=False)
+
+
+# ---------------------------------------------------------------------------
+# detach after async write-back: exactly once per dirty tile
+# ---------------------------------------------------------------------------
+
+def test_detach_after_async_writeback_commits_exactly_once():
+    """Tiles the committer already landed mid-run must NOT be committed
+    again by detach's sync flush: bytes_out counts every dirty tile's
+    payload exactly once, and values are the final versions."""
+    NT, N = 4, 512  # 512x512 f64 = 2 MB/tile > the 1 MB watermark
+    _set("runtime", "wb_window_mb", 1)
+    ctx = Context(nb_cores=2)
+    try:
+        dev = tpu_dev(ctx)
+        tiles = [data_create(i, payload=np.zeros((N, N))) for i in range(NT)]
+        tp = DTDTaskpool(ctx)
+        for i, t in enumerate(tiles):
+            tp.insert_task({DEV_TPU: lambda x, i=i: x + float(i + 1)},
+                           (t, INOUT))
+        assert tp.wait(timeout=120)
+        com = dev._wb_committer()
+        assert com is not None, "stage_depth default engages the committer"
+        com.flush()
+        committed_async = com.stats["committed"]
+        assert committed_async > 0, "watermark never drained mid-run"
+    finally:
+        ctx.fini()  # detach: flush barrier + sync batch for the rest
+        _unset("runtime", "wb_window_mb")
+    tile_bytes = N * N * 8
+    # exactly once per dirty tile: async commits + detach commits == NT
+    assert dev.stats["bytes_out"] == NT * tile_bytes
+    for i, t in enumerate(tiles):
+        hc = t.get_copy(0)
+        np.testing.assert_allclose(np.asarray(hc.payload), float(i + 1))
+        assert hc.version == t.newest_copy().version  # no stale rollback
+
+
+# ---------------------------------------------------------------------------
+# custom stage hooks x deferred write-back
+# ---------------------------------------------------------------------------
+
+def test_custom_stage_hooks_compose_with_deferred_writeback():
+    """A packed custom-staged device copy must never be flushed home by
+    the committer (it is NOT home layout); the pre-flushed host copy
+    carries the version and the scatter hook's output lands exact."""
+    import jax.numpy as jnp
+
+    from parsec_tpu.data import LocalCollection
+    from parsec_tpu.dsl.ptg import INOUT as P_INOUT, PTG
+
+    _set("runtime", "wb_window_mb", 1)
+    ctx = Context(nb_cores=2)
+    try:
+        dev = tpu_dev(ctx)
+        N, NT = 512, 3  # full tiles are 2 MB: enqueues cross the watermark
+        base = np.arange(float(N * N)).reshape(N, N)
+        dc = LocalCollection("A", shape=(N, N), init=lambda k: base.copy())
+
+        def pack(data, device):
+            return jnp.asarray(
+                np.asarray(data.newest_copy().payload)[:, ::2])
+
+        def scatter(arr, data, device):
+            full = jnp.asarray(np.asarray(data.get_copy(0).payload))
+            return full.at[:, ::2].set(arr)
+
+        ptg = PTG("stagewb")
+        t = ptg.task_class("t", k=f"0 .. {NT - 1}")
+        t.affinity("A(k)")
+        t.flow("X", P_INOUT, "<- A(k)", "-> A(k)")
+        t.stage("X", stage_in=pack, stage_out=scatter)
+        t.body(tpu=lambda X, k: X * 10.0)
+        tp = ptg.taskpool(A=dc)
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=120)
+        com = dev._wb_committer()
+        assert com is not None
+        com.flush()
+        # the epilog ran stage_out (scatter) BEFORE enqueueing, so the
+        # deferred commits are home-layout — one per task output
+        assert com.stats["committed"] == NT
+        expect = base.copy()
+        expect[:, ::2] *= 10.0
+        from parsec_tpu.dsl.dtd import stage_to_cpu
+
+        for k in range(NT):
+            np.testing.assert_allclose(stage_to_cpu(dc.data_of(k)), expect)
+    finally:
+        ctx.fini()
+        _unset("runtime", "wb_window_mb")
+
+
+def test_packed_read_copy_never_flushed_home(ctx):
+    """A READ flow's pack hook leaves a PACKED device copy (staged_by
+    marker set, no epilog to unpack it): the committer must drop it —
+    flushing a packed representation home would corrupt the tile."""
+    import jax.numpy as jnp
+
+    from parsec_tpu.data import LocalCollection
+    from parsec_tpu.dsl.ptg import IN, PTG
+
+    dev = tpu_dev(ctx)
+    com = dev._wb_committer()
+    assert com is not None
+    N = 8
+    base = np.arange(float(N * N)).reshape(N, N)
+    dc = LocalCollection("A", shape=(N, N), init=lambda k: base.copy())
+
+    def pack(data, device):
+        return jnp.asarray(np.asarray(data.newest_copy().payload)[:, ::2])
+
+    ptg = PTG("pkro")
+    t = ptg.task_class("t", k="0 .. 0")
+    t.affinity("A(0)")
+    t.flow("X", IN, "<- A(0)")
+    t.stage("X", stage_in=pack)
+    t.body(tpu=lambda X, k: ())
+    tp = ptg.taskpool(A=dc)
+    ctx.add_taskpool(tp)
+    assert tp.wait(timeout=60)
+    d = dc.data_of(0)
+    assert d.get_copy(dev.data_index) is not None  # packed copy resident
+    before = np.asarray(d.get_copy(0).payload).copy()
+    com.enqueue(d)
+    com.flush()
+    assert com.stats["dropped_stale"] >= 1
+    np.testing.assert_array_equal(np.asarray(d.get_copy(0).payload), before)
+
+
+def test_committer_death_fails_pool_not_hang():
+    """An injected D2H failure inside the committer thread surfaces as
+    a pool failure (the next epilog enqueue re-raises the sticky error)
+    — the run terminates, it does not wedge."""
+    _set("runtime", "wb_window_mb", 1)
+    ctx = Context(nb_cores=2)
+    try:
+        dev = tpu_dev(ctx)
+        com = dev._wb_committer()
+        assert com is not None
+        orig = dev._d2h_batch
+        state = {"boomed": False}
+
+        def boom(payloads):
+            if not state["boomed"]:
+                state["boomed"] = True
+                raise RuntimeError("injected D2H failure")
+            return orig(payloads)
+
+        dev._d2h_batch = boom
+        d = data_create("chain", payload=np.zeros((512, 512)))  # 2 MB
+        tp = DTDTaskpool(ctx)
+        for _ in range(10):
+            tp.insert_task({DEV_TPU: lambda x: x + 1.0}, (d, INOUT))
+        ok = tp.wait(timeout=120)
+        if ok:
+            # the pool drained before the committer's first (failing)
+            # drain hit an enqueue: force it — the failure must still
+            # surface loudly at the flush barrier
+            com.kick()
+            deadline = time.monotonic() + 30
+            while com.error is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            with pytest.raises(RuntimeError, match="committer"):
+                com.flush()
+        else:
+            # the sticky error re-raised at an epilog enqueue: pool
+            # failure, not a hang
+            assert state["boomed"]
+        assert not com.healthy
+        # teardown below must not trip over the dead committer: drop it
+        # (detach then takes the synchronous batch path) and restore D2H
+        dev._d2h_batch = orig
+        com.close(flush=False)
+        dev._committer = None
+    finally:
+        ctx.fini()
+        _unset("runtime", "wb_window_mb")
+
+
+# ---------------------------------------------------------------------------
+# eviction routes through the committer
+# ---------------------------------------------------------------------------
+
+def test_eviction_writeback_routes_through_committer(ctx):
+    """Under budget pressure the LRU victim's dirty copy is committed by
+    the async committer (kick + wait), not the blocking per-tile get —
+    and every tile's data survives eviction."""
+    dev = tpu_dev(ctx)
+    com = dev._wb_committer()
+    assert com is not None, "stage_depth default engages the committer"
+    dev.hbm_budget = 4 * 1024 * 8  # room for ~4 tiles of 1024 f64
+    tiles = [data_create(i, payload=np.full((1024,), float(i)))
+             for i in range(12)]
+    tp = DTDTaskpool(ctx)
+    for t in tiles:
+        tp.insert_task({DEV_TPU: lambda x: x + 0.0}, (t, INOUT))
+    assert tp.wait(timeout=120)
+    assert dev.stats["evictions"] > 0
+    assert com.drained() > 0, "eviction write-backs bypassed the committer"
+    from parsec_tpu.dsl.dtd import stage_to_cpu
+
+    for i, t in enumerate(tiles):
+        np.testing.assert_allclose(stage_to_cpu(t), float(i))
+
+
+# ---------------------------------------------------------------------------
+# pipeline on/off: bit-identical dynamic-runtime digests
+# ---------------------------------------------------------------------------
+
+def _dynamic_dpotrf_digest(depth):
+    from parsec_tpu.analysis.schedules import tile_digest
+    from parsec_tpu.datadist import TiledMatrix
+    from parsec_tpu.ops.cholesky import cholesky_ptg
+
+    rng = np.random.default_rng(17)
+    n, nb = 96, 24
+    M = rng.standard_normal((n, n))
+    S = M @ M.T + n * np.eye(n)
+    A = TiledMatrix(n, n, nb, nb, name="A", dtype=np.float64).from_array(S)
+    tp = cholesky_ptg(use_tpu=True, use_cpu=False).taskpool(NT=A.mt, A=A)
+    _set("runtime", "stage_depth", depth)
+    ctx = Context(nb_cores=2)
+    try:
+        ctx.add_taskpool(tp)
+        assert tp.wait(timeout=120)
+    finally:
+        ctx.fini()
+        _unset("runtime", "stage_depth")
+    return tile_digest(A), S, A
+
+
+def test_dynamic_digests_identical_pipeline_on_vs_off():
+    """The acceptance bar: same schedule class, stage_depth 1 (all
+    transfers synchronous) vs 2 (prefetch + deferred write-back) land
+    bit-identical tiles.  Wave batching off: wave composition is
+    schedule-dependent and vmapped kernels need not match singles."""
+    _set("device", "tpu_wave_batch", 0)
+    try:
+        off, S, _ = _dynamic_dpotrf_digest(1)
+        on, _, A = _dynamic_dpotrf_digest(2)
+    finally:
+        _unset("device", "tpu_wave_batch")
+    assert on == off, "staging pipeline changed numerics"
+    L = np.tril(A.to_array())
+    np.testing.assert_allclose(L @ L.T, S, rtol=1e-10, atol=1e-10)
