@@ -490,7 +490,21 @@ extern "C" {
 
 void* pz_graph_new(void) { return new Graph(); }
 
-void pz_graph_destroy(void* gp) { delete static_cast<Graph*>(gp); }
+// Destroy synchronizes with stragglers whose last action was releasing
+// one of the graph's locks (a drain thread finishing its final
+// pz_graph_events_drain, a pump thread's last done_batch): acquiring
+// each mutex once here orders those unlocks before the frees in
+// ~Graph.  Callers still must not issue NEW pz_graph_* calls
+// concurrently with destroy.
+void pz_graph_destroy(void* gp) {
+    Graph* g = static_cast<Graph*>(gp);
+    { std::lock_guard<std::mutex> lk(g->graph_mu); }
+    { std::lock_guard<std::mutex> lk(g->ready_mu); }
+    { std::lock_guard<std::mutex> lk(g->sq.mu); }
+    { std::lock_guard<std::mutex> lk(g->ev_mu); }
+    for (WorkerQ& w : g->wqs) { std::lock_guard<std::mutex> lk(w.mu); }
+    delete g;
+}
 
 // Add a task; returns its id. May be called while run() is live
 // (streaming/DTD insertion). Declare predecessors with pz_graph_add_dep,

@@ -134,6 +134,58 @@ CODES = {
                         "committer thread died) — detach()/flush() "
                         "would block; the finding names the device, "
                         "the pending count/bytes and any stored error"),
+    # ENG0xx: NATIVE-ENGINE findings (native.abi ABI contract lint,
+    # analysis.engine_verify lifecycle model checker + conformance
+    # replay + clang-tidy gate) — defects of the C++ engine, its ctypes
+    # boundary, or its event drain.  Same append-only contract.
+    "ENG001": (ERROR, "ABI: a symbol the spec declares is missing from "
+                      "the built native library (stale .so, or the "
+                      "definition was dropped)"),
+    "ENG002": (ERROR, "ABI: the native core exports a pz_*/pt_* entry "
+                      "point the ABI spec does not declare (undeclared "
+                      "export: ctypes callers would bind it blind)"),
+    "ENG003": (ERROR, "ABI: signature drift between the declarative "
+                      "spec and the extern \"C\" prototype in "
+                      "native/src/ (argument or return type mismatch "
+                      "at the ctypes boundary corrupts silently)"),
+    "ENG004": (ERROR, "ABI: the spec declares an entry point that "
+                      "native/src/ does not define"),
+    "ENG005": (WARNING, "ABI: the built native library is older than "
+                        "native/src/ (stale build — rebuild before "
+                        "trusting any engine behavior)"),
+    "ENG006": (ERROR, "ABI: trace record layout drift between the "
+                      "spec, trace.cpp's struct Record, and the "
+                      "Python .pbt reader (on-disk corruption)"),
+    "ENG010": (ERROR, "model: a task did not retire exactly once "
+                      "(lost or duplicated retire in an explored "
+                      "interleaving)"),
+    "ENG011": (ERROR, "model: quiescence declared while a task was "
+                      "still in flight (early quiesce would drop "
+                      "in-flight work on the floor)"),
+    "ENG012": (ERROR, "model: event-drain defect — an EVT_DEP_DEC/"
+                      "EVT_PUBLISH/EVT_RETIRE was dropped, duplicated, "
+                      "or drained in an order inconsistent with "
+                      "happens-before (the drain lied; every RT0xx "
+                      "verdict built on it is untrustworthy)"),
+    "ENG013": (ERROR, "model: wdrr starvation — a nonempty tenant bin "
+                      "was never served while another tenant popped "
+                      "(deficit round robin lost a bin)"),
+    "ENG014": (ERROR, "conformance: the real engine's drained event "
+                      "stream diverges from the lifecycle model "
+                      "(infeasible count, order, or quiescence edge)"),
+    "ENG020": (ERROR, "clang-tidy diagnostic in native/src/ (the "
+                      "zero-warning gate: fix it or add a documented "
+                      "suppression)"),
+    "ENG021": (INFO, "clang tooling unavailable: the C++ static-"
+                     "analysis leg was skipped, not passed"),
+    # DOC0xx: DOCUMENTATION-DRIFT findings (analysis.doc_lint) — the
+    # operator-facing docs and the source tree disagree.
+    "DOC001": (ERROR, "registered MCA param is not documented in "
+                      "docs/OPERATIONS.md (operators cannot discover "
+                      "the knob)"),
+    "DOC002": (ERROR, "docs/OPERATIONS.md documents an MCA param no "
+                      "source registers (removed knob, or a typo in "
+                      "the row)"),
 }
 
 

@@ -250,8 +250,12 @@ class _SegPull:
                                         lambda _b: None, fin=True)
             except Exception:
                 pass
-            self.op._fail(f"segment pull of {self.handle!r} from rank "
-                          f"{self.src} failed")
+            # symptom, not cause: defer so the origin's "err" notice
+            # (already in flight when its staging teardown broke this
+            # pull) supplies the root-cause reason — see _fail_deferred
+            self.op._fail_deferred(
+                f"segment pull of {self.handle!r} from rank "
+                f"{self.src} failed")
             return
         self.op.mgr.stats["seg_done"] += 1
         self.op.mgr.stats["bytes_landed"] += ln
@@ -300,6 +304,9 @@ class _BaseOp:
         self.done = False
         self.failed = False
         self.fail_reason: Optional[str] = None
+        #: (reason, deadline) of a deferred local failure — see
+        #: :meth:`_fail_deferred`
+        self._pending_fail: Optional[Tuple[str, float]] = None
         self._result = None
         #: holders (pool-slot views) kept alive until the op dies
         self._holders: List[Any] = []
@@ -371,6 +378,41 @@ class _BaseOp:
                                         priority=self.priority)
                     except Exception:
                         pass  # a dead peer cannot mask the local failure
+
+    def _fail_deferred(self, why: str) -> None:
+        """Record a LOCAL failure whose root cause lives on a peer.
+
+        A failed segment pull is almost always a *symptom*: the origin
+        rank tore down its staging registration inside its own
+        ``_fail``, whose very next step notifies every peer with the
+        root-cause reason ("advert mismatch ...").  Failing immediately
+        here races that in-flight "err" notice — whichever rank's pull
+        tripped first would raise the generic pull message instead of
+        the origin's reason (the pre-PR-20 allgather-fails-loudly
+        flake).  So: park the generic reason with a grace deadline and
+        keep the op bound; the peer's "err" fails the op with the real
+        reason via ``on_msg``, and only a genuinely silent peer (died
+        without notifying) lets the deadline expire — ``wait()`` then
+        applies the parked reason, preserving liveness."""
+        with self._lock:
+            if self.done or self.failed or self._pending_fail is not None:
+                return
+            self._pending_fail = (why, time.monotonic() + self.mgr.err_grace)
+            self._cv.notify_all()
+        debug.verbose(2, "coll",
+                      "collective %r on rank %d: deferring local failure "
+                      "(%s) for a peer's root-cause notice", self.cid,
+                      self.ce.rank, why)
+
+    def _check_pending_fail(self) -> None:
+        """Apply an expired deferred failure (called from wait())."""
+        with self._lock:
+            pf = self._pending_fail
+            if pf is None or self.done or self.failed:
+                return
+            if time.monotonic() < pf[1]:
+                return
+        self._fail(pf[0])
 
     def _bind(self) -> None:
         """Bind this op to the endpoint, accounting a duplicate-cid
@@ -499,6 +541,7 @@ class _BaseOp:
         # caller's pump, tightly.
         self_prog = bool(getattr(self.ce, "self_progressing", False))
         while True:
+            self._check_pending_fail()
             with self._lock:
                 if self.failed:
                     raise CollError(
@@ -1339,6 +1382,11 @@ class CollManager:
                  "(below 0 = after dependency activations in a shared "
                  "frame, so bulk collectives never starve the critical "
                  "path)"))
+        self.err_grace = float(mca_param.register(
+            "runtime", "coll_err_grace", 5.0,
+            help="seconds a locally-detected segment-pull failure waits "
+                 "for the origin rank's root-cause err notice before the "
+                 "generic reason is raised (0 = fail immediately)"))
         self.stats = collections.Counter()
         self.pool = BytePool(f"coll{getattr(ce, 'rank', 0)}")
         self._ops: Dict[Any, _BaseOp] = {}

@@ -67,6 +67,14 @@ class Context:
             from ..analysis import lockdep as _lockdep
 
             _lockdep.install()
+        # PARSEC_TPU_ABI_CHECK=1|strict — lint the native library's ABI
+        # against the declarative spec (native.abi) before any ctypes
+        # call crosses it: a stale or drifted libparsec_core.so corrupts
+        # silently at the boundary, so catch it at startup (strict
+        # raises; 1 prints the ENG findings and continues)
+        abi_mode = os.environ.get("PARSEC_TPU_ABI_CHECK", "0").strip().lower()
+        if abi_mode not in ("", "0"):
+            self._abi_check(strict=abi_mode == "strict")
         if nb_cores is None:
             nb_cores = mca_param.register(
                 "runtime", "num_cores", min(os.cpu_count() or 1, 8),
@@ -207,6 +215,25 @@ class Context:
     # ------------------------------------------------------------------
     # taskpool lifecycle
     # ------------------------------------------------------------------
+    def _abi_check(self, strict: bool) -> None:
+        """PARSEC_TPU_ABI_CHECK startup lint: certify the built native
+        library against the declarative ABI spec (ENG001-ENG006) before
+        the engine is used.  A missing library is not a finding — the
+        pure-Python fallback never crosses the boundary."""
+        from ..analysis.findings import LintError, errors_of
+        from ..native import _LIB_PATH, _SRC_DIR
+        from ..native import abi as _abi
+
+        if not os.path.exists(_LIB_PATH):
+            return
+        findings = _abi.abi_findings(_LIB_PATH, _SRC_DIR)
+        for f in findings:
+            debug.warning("abi-check: %s", f)
+        if strict and errors_of(findings):
+            raise LintError(
+                f"PARSEC_TPU_ABI_CHECK=strict: {_LIB_PATH} drifted from "
+                f"the ABI spec ({len(findings)} finding(s))", findings)
+
     def add_taskpool(self, tp: Taskpool) -> None:
         """Reference ``parsec_context_add_taskpool`` (scheduling.c:832):
         register, notify comm layer, run the startup hook, enqueue the

@@ -79,6 +79,19 @@ def _drain_batch() -> int:
              "batch call (also floors the lifecycle-event drain buffer)"))
 
 
+def _conformance_on() -> bool:
+    from ..utils import mca_param
+
+    return bool(int(mca_param.register(
+        "runtime", "native_conformance", 0,
+        help="1 = certify every pump run's drained lifecycle-event "
+             "stream against the engine-verify model (exactly-once "
+             "publish/retire, dep decrements matching in-degree, "
+             "happens-before drain order); divergence raises LintError "
+             "with ENG014 findings.  Diagnostic mode: the capture and "
+             "replay cost O(events)")))
+
+
 class _TaskInfo:
     """Task stand-in for PINS subscribers on the native path: carries the
     attributes observers read (``task_class.name``, ``prof``, ``repr``)."""
@@ -160,11 +173,15 @@ class _EventDrain:
       the legacy ``task_done`` path fires, double-completes included).
     """
 
-    def __init__(self, ng, pump_index: Dict[int, Any], cap: int):
+    def __init__(self, ng, pump_index: Dict[int, Any], cap: int,
+                 capture: Optional[List[Tuple[int, int, int]]] = None):
         import ctypes
 
         self.ng = ng
         self.index = pump_index
+        #: when set (runtime_native_conformance), every drained record
+        #: is retained raw for the post-quiescence model replay
+        self.capture = capture
         n = max(1024, cap * 4)
         self.k = (ctypes.c_int32 * n)()
         self.a = (ctypes.c_int64 * n)()
@@ -185,6 +202,9 @@ class _EventDrain:
             if n == 0:
                 return total
             total += n
+            if self.capture is not None:
+                self.capture.extend(
+                    (int(k[i]), int(a[i]), int(b[i])) for i in range(n))
             for i in range(n):
                 kind = k[i]
                 if kind == ng.EVT_DEP_DEC:
@@ -385,6 +405,10 @@ class NativeExecutor:
         #: native id -> prebuilt device task, the pump loop's dispatch map
         self._pump_index: Dict[int, _NativeDeviceTask] = {}
         self._roots: List[int] = []
+        #: native-id edges as declared to add_dep, retained only under
+        #: runtime_native_conformance for the post-run stream replay
+        self._conformance = False
+        self._edges: List[Tuple[int, int]] = []
         self._pool_shim: Optional[_NativePoolShim] = None
         if self.native_device:
             if device is None:
@@ -479,6 +503,9 @@ class NativeExecutor:
             else self._native.NativeGraph()
         self._ng = ng
         index = self._index = {}
+        # conformance mode retains the declared edges so the post-run
+        # replay can rebuild the DAG in native-id space
+        self._conformance = _conformance_on()
 
         order = list(g.nodes)
         region_native: Dict[int, int] = {}
@@ -529,6 +556,8 @@ class NativeExecutor:
                     continue
                 seen_edges.add((me, tgt))
                 ng.add_dep(me, tgt)
+                if self._conformance:
+                    self._edges.append((me, tgt))
                 has_pred.add(tgt)
         self._roots = [nid for nid in dict.fromkeys(index.values())
                        if nid not in has_pred]
@@ -558,10 +587,12 @@ class NativeExecutor:
                      "explorer's replay hook; -1 = unseeded fuzzing)"))
             ng.sched_config(policy="prio", quantum=0, seed=seed)
             self._pump = True
-        if self._pump and (pins.active(pins.DEP_DECREMENT)
+        if self._pump and (self._conformance
+                           or pins.active(pins.DEP_DECREMENT)
                            or pins.active(pins.NATIVE_TASK_DONE)):
-            # observers already installed: arm the native event buffer
-            # now so commit-time source publishes are captured too
+            # observers already installed (or conformance certification
+            # requested): arm the native event buffer now so commit-time
+            # source publishes are captured too
             ng.events_enable(True)
             self._events_on = True
         # commit only after EVERY edge is declared: committing a task arms
@@ -1040,7 +1071,11 @@ class NativeExecutor:
                     t = self._pump_index.get(nid)
                     if t is not None:
                         pins.fire(pins.SCHEDULE_BEGIN, None, (t,))
-        ev = _EventDrain(ng, self._pump_index, _drain_batch()) \
+        capture: Optional[List[Tuple[int, int, int]]] = \
+            [] if self._conformance else None
+        if self._conformance:
+            drain = True
+        ev = _EventDrain(ng, self._pump_index, _drain_batch(), capture) \
             if drain else None
         tp = self.taskpool
 
@@ -1050,8 +1085,29 @@ class NativeExecutor:
             tp.task_done_batch(sum(
                 int(getattr(t, "fused_n", 1) or 1) for t in batch))
 
-        return _pump_loop(ng, self.device, self._pump_index, self.stats,
-                          (self._pool_shim,), ev, retire_cb)
+        n = _pump_loop(ng, self.device, self._pump_index, self.stats,
+                       (self._pool_shim,), ev, retire_cb)
+        if capture is not None:
+            self._certify_drain(capture)
+        return n
+
+    def _certify_drain(self, events: List[Tuple[int, int, int]]) -> None:
+        """runtime_native_conformance: replay the drained lifecycle
+        stream against the engine-verify model; divergence (ENG014) is
+        a loud LintError — the drain lied about what the engine did."""
+        from ..analysis import engine_verify
+        from ..analysis.findings import LintError
+
+        n_tasks = max(dict.fromkeys(self._index.values()), default=-1) + 1
+        dag = engine_verify.SeedDag(
+            f"pump:{self.taskpool.ptg.name}", n_tasks, tuple(self._edges))
+        fs = engine_verify.conformance_findings(
+            dag, events, quiesced=self._ng.quiesced())
+        if fs:
+            raise LintError(
+                f"native pump drain failed conformance ({len(fs)} "
+                "finding(s))", fs)
+        self.stats["conformance_events"] = len(events)
 
     def _apply_vpmap(self, nthreads: int) -> None:
         from ..utils import mca_param

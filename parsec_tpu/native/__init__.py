@@ -28,6 +28,8 @@ import threading
 from typing import Any, Callable, List, Optional, Sequence
 
 from ..profiling import pins
+from . import abi
+from .abi import ASYNC_BODY_FN, BODY_FN
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(os.path.dirname(_HERE))
@@ -50,36 +52,15 @@ _build_error: Optional[str] = None
 
 _SOURCES = ["zone.cpp", "graph.cpp", "trace.cpp"]
 
-#: every C entry point the bindings below require.  Checked explicitly at
-#: load so a stale ``native/build/libparsec_core.so`` (e.g. sources updated
-#: but the rebuild failed or was skipped) produces ONE readable error via
-#: :func:`build_error` instead of a ctypes ``AttributeError`` deep inside a
-#: consumer.  ``missing_symbols()`` is the CI smoke hook over this list.
-REQUIRED_SYMBOLS = [
-    # zone allocator
-    "pz_zone_new", "pz_zone_destroy", "pz_zone_alloc", "pz_zone_release",
-    "pz_zone_used", "pz_zone_capacity", "pz_zone_largest_free",
-    "pz_zone_num_live",
-    # graph engine
-    "pz_graph_new", "pz_graph_destroy", "pz_graph_add_task",
-    "pz_graph_add_dep", "pz_graph_task_commit", "pz_graph_seal",
-    "pz_graph_run", "pz_graph_run_async", "pz_task_done", "pz_graph_fail",
-    "pz_graph_executed", "pz_graph_double_completes",
-    "pz_graph_set_policy", "pz_graph_steals",
-    "pz_graph_steals_remote", "pz_graph_set_vpmap", "pz_graph_reset",
-    "pz_graph_run_noop", "pz_graph_order",
-    # zero-interpreter lifecycle (pump mode, PR 18)
-    "pz_graph_sched_config", "pz_graph_task_tenant",
-    "pz_graph_tenant_weight", "pz_graph_pop_batch", "pz_graph_done_batch",
-    "pz_graph_quiesced", "pz_graph_sched_pending",
-    "pz_graph_events_enable", "pz_graph_events_drain",
-    # standalone ready queue (native-mirror for the Python schedulers)
-    "pz_rq_new", "pz_rq_destroy", "pz_rq_tenant_weight", "pz_rq_push",
-    "pz_rq_pop", "pz_rq_count", "pz_rq_clear",
-    # binary tracer
-    "pt_tracer_new", "pt_tracer_destroy", "pt_stream_new", "pt_stream_id",
-    "pt_log", "pt_total_events", "pt_dump",
-]
+#: every C entry point the bindings require — a DERIVED view of the
+#: declarative ABI contract (:mod:`parsec_tpu.native.abi`; one spec
+#: generates the bindings, this list, and the engine-verify ABI lint).
+#: Checked explicitly at load so a stale
+#: ``native/build/libparsec_core.so`` (e.g. sources updated but the
+#: rebuild failed or was skipped) produces ONE readable error via
+#: :func:`build_error` instead of a ctypes ``AttributeError`` deep
+#: inside a consumer.  ``missing_symbols()`` is the CI smoke hook.
+REQUIRED_SYMBOLS = abi.required_symbols()
 
 
 def _newest_mtime(paths: Sequence[str]) -> float:
@@ -150,119 +131,13 @@ def _load():
                 f"{', '.join(missing)} — delete native/build/ (or touch "
                 "native/src/*.cpp) to force a rebuild")
             return None
-        # zone allocator
-        lib.pz_zone_new.restype = ctypes.c_void_p
-        lib.pz_zone_new.argtypes = [ctypes.c_size_t]
-        lib.pz_zone_destroy.argtypes = [ctypes.c_void_p]
-        lib.pz_zone_alloc.restype = ctypes.c_int64
-        lib.pz_zone_alloc.argtypes = [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t]
-        lib.pz_zone_release.restype = ctypes.c_int
-        lib.pz_zone_release.argtypes = [ctypes.c_void_p, ctypes.c_int64]
-        lib.pz_zone_used.restype = ctypes.c_size_t
-        lib.pz_zone_used.argtypes = [ctypes.c_void_p]
-        lib.pz_zone_capacity.restype = ctypes.c_size_t
-        lib.pz_zone_capacity.argtypes = [ctypes.c_void_p]
-        lib.pz_zone_largest_free.restype = ctypes.c_int64
-        lib.pz_zone_largest_free.argtypes = [ctypes.c_void_p]
-        lib.pz_zone_num_live.restype = ctypes.c_int64
-        lib.pz_zone_num_live.argtypes = [ctypes.c_void_p]
-        # graph engine
-        lib.pz_graph_new.restype = ctypes.c_void_p
-        lib.pz_graph_destroy.argtypes = [ctypes.c_void_p]
-        lib.pz_graph_add_task.restype = ctypes.c_int64
-        lib.pz_graph_add_task.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_int64]
-        lib.pz_graph_add_dep.restype = ctypes.c_int
-        lib.pz_graph_add_dep.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
-        lib.pz_graph_task_commit.argtypes = [ctypes.c_void_p, ctypes.c_int64]
-        lib.pz_graph_seal.argtypes = [ctypes.c_void_p]
-        lib.pz_graph_run.restype = ctypes.c_int64
-        lib.pz_graph_run.argtypes = [ctypes.c_void_p, BODY_FN, ctypes.c_void_p,
-                                     ctypes.c_int32]
-        lib.pz_graph_run_async.restype = ctypes.c_int64
-        lib.pz_graph_run_async.argtypes = [ctypes.c_void_p, ASYNC_BODY_FN,
-                                           ctypes.c_void_p, ctypes.c_int32]
-        lib.pz_task_done.restype = ctypes.c_int
-        lib.pz_task_done.argtypes = [ctypes.c_void_p, ctypes.c_int64]
-        lib.pz_graph_fail.argtypes = [ctypes.c_void_p]
-        lib.pz_graph_executed.restype = ctypes.c_int64
-        lib.pz_graph_executed.argtypes = [ctypes.c_void_p]
-        lib.pz_graph_double_completes.restype = ctypes.c_int64
-        lib.pz_graph_double_completes.argtypes = [ctypes.c_void_p]
-        lib.pz_graph_set_policy.argtypes = [ctypes.c_void_p, ctypes.c_int32]
-        lib.pz_graph_steals.restype = ctypes.c_int64
-        lib.pz_graph_steals.argtypes = [ctypes.c_void_p]
-        lib.pz_graph_steals_remote.restype = ctypes.c_int64
-        lib.pz_graph_steals_remote.argtypes = [ctypes.c_void_p]
-        lib.pz_graph_set_vpmap.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
-        lib.pz_graph_reset.restype = ctypes.c_int
-        lib.pz_graph_reset.argtypes = [ctypes.c_void_p]
-        lib.pz_graph_run_noop.restype = ctypes.c_int64
-        lib.pz_graph_run_noop.argtypes = [ctypes.c_void_p, ctypes.c_int32]
-        lib.pz_graph_order.restype = ctypes.c_int64
-        lib.pz_graph_order.argtypes = [ctypes.c_void_p,
-                                       ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
-        # zero-interpreter lifecycle (pump mode)
-        lib.pz_graph_sched_config.argtypes = [
-            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64]
-        lib.pz_graph_task_tenant.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32]
-        lib.pz_graph_tenant_weight.argtypes = [
-            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
-        lib.pz_graph_pop_batch.restype = ctypes.c_int64
-        lib.pz_graph_pop_batch.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
-        lib.pz_graph_done_batch.restype = ctypes.c_int64
-        lib.pz_graph_done_batch.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
-        lib.pz_graph_quiesced.restype = ctypes.c_int32
-        lib.pz_graph_quiesced.argtypes = [ctypes.c_void_p]
-        lib.pz_graph_sched_pending.restype = ctypes.c_int64
-        lib.pz_graph_sched_pending.argtypes = [ctypes.c_void_p]
-        lib.pz_graph_events_enable.argtypes = [ctypes.c_void_p, ctypes.c_int32]
-        lib.pz_graph_events_drain.restype = ctypes.c_int64
-        lib.pz_graph_events_drain.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
-            ctypes.c_int64]
-        # standalone ready queue
-        lib.pz_rq_new.restype = ctypes.c_void_p
-        lib.pz_rq_new.argtypes = [ctypes.c_int32, ctypes.c_int32,
-                                  ctypes.c_int64]
-        lib.pz_rq_destroy.argtypes = [ctypes.c_void_p]
-        lib.pz_rq_tenant_weight.argtypes = [
-            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
-        lib.pz_rq_push.argtypes = [ctypes.c_void_p, ctypes.c_int64,
-                                   ctypes.c_int64, ctypes.c_int32,
-                                   ctypes.c_int64]
-        lib.pz_rq_pop.restype = ctypes.c_int64
-        lib.pz_rq_pop.argtypes = [ctypes.c_void_p]
-        lib.pz_rq_count.restype = ctypes.c_int64
-        lib.pz_rq_count.argtypes = [ctypes.c_void_p]
-        lib.pz_rq_clear.argtypes = [ctypes.c_void_p]
-        # binary tracer
-        lib.pt_tracer_new.restype = ctypes.c_void_p
-        lib.pt_tracer_destroy.argtypes = [ctypes.c_void_p]
-        lib.pt_stream_new.restype = ctypes.c_void_p
-        lib.pt_stream_new.argtypes = [ctypes.c_void_p]
-        lib.pt_stream_id.restype = ctypes.c_int32
-        lib.pt_stream_id.argtypes = [ctypes.c_void_p]
-        lib.pt_log.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
-                               ctypes.c_int32, ctypes.c_int32,
-                               ctypes.c_int64, ctypes.c_int64]
-        lib.pt_total_events.restype = ctypes.c_int64
-        lib.pt_total_events.argtypes = [ctypes.c_void_p]
-        lib.pt_dump.restype = ctypes.c_int64
-        lib.pt_dump.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        # restype/argtypes for every entry point are GENERATED from the
+        # declarative ABI contract — the spec that also feeds
+        # REQUIRED_SYMBOLS and the engine-verify ABI lint, so bindings
+        # cannot drift from what the lint certifies
+        abi.bind(lib)
         _lib = lib
         return lib
-
-
-BODY_FN = ctypes.CFUNCTYPE(None, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p)
-#: async-capable body: returns 0 = completed synchronously, nonzero =
-#: ASYNC (completion arrives later via ``NativeGraph.task_done``)
-ASYNC_BODY_FN = ctypes.CFUNCTYPE(
-    ctypes.c_int32, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p)
 
 
 def missing_symbols() -> List[str]:

@@ -418,6 +418,17 @@ def analyze(events: List[dict], *, exec_name: str = "exec",
         last = max(t["end"] for t in tasks.values())
         submit, admit = ph.get("submit_us"), ph.get("admit_us")
         done = ph.get("done_us")
+        # Remote ranks' exec spans carry residual cross-rank clock-
+        # correction error (merge's piecewise alignment is ~us-accurate,
+        # not exact), so a corrected remote end can land just past the
+        # submitting rank's done instant.  The job_phase envelope bounds
+        # the job's true lifetime by construction: clamp the run window
+        # into it so the partition stays self-consistent (run <= total,
+        # drain >= 0) instead of reporting a run that outlives its job.
+        if submit is not None:
+            first, last = max(first, submit), max(last, submit)
+        if done is not None:
+            first, last = min(first, done), min(last, done)
         phases = {
             "queue_us": max(0.0, admit - submit)
             if submit is not None and admit is not None else None,

@@ -431,6 +431,99 @@ def cmd_hbcheck(args) -> int:
     return 0
 
 
+def cmd_engine_verify(args) -> int:
+    """Verify the native engine: ABI contract lint, exhaustive
+    lifecycle model checking, conformance replay of a real pump run,
+    clang-tidy gate (see parsec_tpu.analysis.engine_verify)."""
+    from ..analysis import errors_of
+    from ..analysis.engine_verify import verify_engine
+    from ..analysis.findings import infos_of
+
+    legs = [leg for leg in ("abi", "model", "conformance", "tidy")
+            if getattr(args, leg)]
+    if args.all or not legs:
+        legs = ["abi", "model", "conformance", "tidy"]
+    findings, stats = verify_engine(
+        legs, workers=args.workers, conformance_nt=args.nt,
+        conformance_seeds=tuple(range(args.seeds)))
+    for f in findings:
+        print(f)
+    for leg in legs:
+        st = stats.get(leg)
+        if leg == "model" and isinstance(st, dict):
+            for dag, s in st.items():
+                print(f"engine-verify: model {dag}: {s['states']} "
+                      f"state(s), {s['transitions']} transition(s), "
+                      f"{s['sleep_skips']} sleep-skip(s)"
+                      + (" TRUNCATED" if s["truncated"] else ""))
+        elif st:
+            print(f"engine-verify: {leg}: {st}")
+    errs = len(errors_of(findings))
+    infos = len(infos_of(findings))
+    print(f"engine-verify: {'+'.join(legs)}: {errs} error(s), "
+          f"{len(findings) - errs - infos} warning(s), {infos} skipped")
+    if errs:
+        return 1
+    if args.strict and len(findings) - infos:
+        return 1
+    return 0
+
+
+def cmd_check(args) -> int:
+    """One-shot aggregate gate: graph lint over every registered PTG,
+    the ABI contract lint, the lifecycle model checker, the MCA
+    doc-drift lint, and clang-tidy when present — one summary table,
+    one exit code."""
+    import types as _types
+
+    from ..analysis import errors_of
+    from ..analysis.doc_lint import doc_findings
+    from ..analysis.engine_verify import verify_engine
+    from ..analysis.findings import infos_of
+
+    rows = []  # (section, errors, warnings, skipped)
+
+    def _run(section, fn):
+        try:
+            findings = fn()
+        except Exception as e:  # a crashed checker is a failed gate
+            print(f"check: {section}: FAILED: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            rows.append((section, 1, 0, 0))
+            return
+        for f in findings:
+            print(f"{section}: {f}")
+        errs = len(errors_of(findings))
+        infos = len(infos_of(findings))
+        rows.append((section, errs, len(findings) - errs - infos, infos))
+
+    lint_args = _types.SimpleNamespace(targets=[], all=True, strict=False,
+                                       ignore=args.ignore, define=None)
+    rc_lint = cmd_lint(lint_args)
+    rows.append(("graph-lint", 1 if rc_lint else 0, 0, 0))
+    _run("abi", lambda: verify_engine(("abi",))[0])
+    _run("model", lambda: verify_engine(
+        ("model",), workers=args.workers)[0])
+    _run("doc-drift", doc_findings)
+    _run("tidy", lambda: verify_engine(("tidy",))[0])
+    if args.hbcheck:
+        hb_args = _types.SimpleNamespace(traces=args.hbcheck, strict=False)
+        rc_hb = cmd_hbcheck(hb_args)
+        rows.append(("hbcheck", 1 if rc_hb == 1 else 0, 0, 0))
+
+    width = max(len(r[0]) for r in rows)
+    print(f"\n{'section'.ljust(width)}  errors  warnings  skipped  verdict")
+    n_err = 0
+    for section, errs, warns, infos in rows:
+        n_err += errs
+        verdict = "FAIL" if errs else ("skip" if infos and not warns
+                                       else "ok")
+        print(f"{section.ljust(width)}  {errs:6d}  {warns:8d}  "
+              f"{infos:7d}  {verdict}")
+    print(f"check: {len(rows)} section(s), {n_err} error(s)")
+    return 1 if n_err else 0
+
+
 def cmd_flightdump(args) -> int:
     """Trigger + collect a flight-recorder snapshot.
 
@@ -747,6 +840,45 @@ def main(argv=None) -> int:
     ph.add_argument("--strict", action="store_true",
                     help="exit non-zero on warnings too, not just races")
     ph.set_defaults(fn=cmd_hbcheck)
+    pv = sub.add_parser(
+        "engine-verify", help="verify the native engine: ABI contract "
+        "lint (spec vs .so exports vs C++ prototypes), exhaustive "
+        "lifecycle model checking with DPOR reduction, conformance "
+        "replay of a real pump run, clang-tidy zero-warning gate "
+        "(ENG0xx findings)")
+    pv.add_argument("--abi", action="store_true",
+                    help="ABI contract lint only")
+    pv.add_argument("--model", action="store_true",
+                    help="lifecycle model checker only")
+    pv.add_argument("--conformance", action="store_true",
+                    help="real-engine conformance replay only")
+    pv.add_argument("--tidy", action="store_true",
+                    help="clang-tidy gate only")
+    pv.add_argument("--all", action="store_true",
+                    help="every leg (the default when none is picked)")
+    pv.add_argument("--workers", type=int, default=2,
+                    help="model worker threads to interleave (default 2)")
+    pv.add_argument("--nt", type=int, default=4,
+                    help="conformance dpotrf tile count (default 4)")
+    pv.add_argument("--seeds", type=int, default=4,
+                    help="conformance schedule-explorer seeds (default 4)")
+    pv.add_argument("--strict", action="store_true",
+                    help="exit non-zero on warnings too (skips exempt)")
+    pv.set_defaults(fn=cmd_engine_verify)
+    pg = sub.add_parser(
+        "check", help="aggregate verification gate: graph lint --all + "
+        "ABI lint + lifecycle model checker + MCA doc-drift lint + "
+        "clang-tidy if present (+ hbcheck over traces you pass); one "
+        "summary table, one exit code")
+    pg.add_argument("--hbcheck", nargs="+", metavar="TRACE",
+                    help="also run the happens-before checker over "
+                    "these .pbt dumps")
+    pg.add_argument("--workers", type=int, default=2,
+                    help="model worker threads to interleave (default 2)")
+    pg.add_argument("--ignore", action="append", metavar="CODES",
+                    help="comma-separated graph-lint finding codes to "
+                    "suppress")
+    pg.set_defaults(fn=cmd_check)
     pf = sub.add_parser(
         "flightdump", help="snapshot a live mesh's flight recorder "
         "(rank<r>.fr.pbt per rank): pass a health endpoint URL "

@@ -1,0 +1,73 @@
+"""MCA-param doc-drift lint (analysis/doc_lint.py): the shipped tree
+is drift-free both directions, and synthetic drift — an undocumented
+registration, a documented ghost knob — fires DOC001/DOC002."""
+
+from parsec_tpu.analysis import doc_lint
+
+
+def test_shipped_tree_is_drift_free():
+    assert doc_lint.doc_findings() == []
+
+
+def test_registered_params_sees_the_real_registry():
+    regs = doc_lint.registered_params()
+    # anchor on long-standing knobs from distinct frameworks
+    assert ("runtime", "comm_eager_limit") in regs
+    assert any(fw == "profiling" for fw, _ in regs)
+
+
+def _tree(tmp_path, source, doc):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "knobs.py").write_text(source)
+    ops = tmp_path / "OPERATIONS.md"
+    ops.write_text(doc)
+    return str(src), str(ops)
+
+
+_DOC_OK = """\
+| param | default | meaning |
+|---|---|---|
+| `runtime_alpha` | 1 | documented knob |
+"""
+
+
+def test_undocumented_registration_fires_doc001(tmp_path):
+    src, ops = _tree(
+        tmp_path,
+        'mca_param.register("runtime", "alpha", 1)\n'
+        'mca_param.register("runtime", "ghost", 0, help="undocumented")\n',
+        _DOC_OK)
+    findings = doc_lint.doc_findings(src, ops)
+    assert [f.code for f in findings] == ["DOC001"]
+    assert "runtime_ghost" in findings[0].message
+
+
+def test_bare_name_prose_mention_counts_as_documented(tmp_path):
+    """A knob explained in prose as `beta` (not a table row) passes —
+    the lint demands documentation, not a specific layout."""
+    src, ops = _tree(
+        tmp_path,
+        'mca_param.register("runtime", "beta", 2)\n',
+        "set `beta` to taste\n")
+    assert doc_lint.doc_findings(src, ops) == []
+
+
+def test_documented_ghost_knob_fires_doc002(tmp_path):
+    src, ops = _tree(
+        tmp_path,
+        'mca_param.register("runtime", "alpha", 1)\n',
+        _DOC_OK + "| `runtime_removed_knob` | 9 | no longer exists |\n")
+    findings = doc_lint.doc_findings(src, ops)
+    assert [f.code for f in findings] == ["DOC002"]
+    assert "runtime_removed_knob" in findings[0].message
+
+
+def test_non_mca_tables_are_ignored(tmp_path):
+    """Metric/finding tables share the | `token` | row shape; only
+    rows whose prefix is a real MCA framework can fire DOC002."""
+    src, ops = _tree(
+        tmp_path,
+        'mca_param.register("runtime", "alpha", 1)\n',
+        _DOC_OK + "| `obs_queue_p99` | gauge | a metric, not a knob |\n")
+    assert doc_lint.doc_findings(src, ops) == []

@@ -5,6 +5,7 @@ a hand-built chain DAG, plus an end-to-end run over a REAL runtime trace
 import numpy as np
 import pytest
 
+from parsec_tpu import native
 from parsec_tpu.profiling import critpath
 
 
@@ -145,9 +146,8 @@ def test_critpath_coll_bucket():
     assert "coll" in critpath.render(rep)
 
 
-@pytest.mark.skipif(
-    not __import__("parsec_tpu").native.available(),
-    reason="binary tracer needs the native core")
+@pytest.mark.skipif(not native.available(),
+                    reason="binary tracer needs the native core")
 def test_critpath_on_real_dynamic_trace(tmp_path):
     """Trace a REAL single-rank chain taskpool (the dynamic-path shape)
     and run the analyzer on the dumped trace: the chain is recovered
@@ -234,3 +234,38 @@ def test_critpath_per_label_rollup():
     assert "attention" in critpath.render(rep)
     # empty report carries the section too
     assert critpath.analyze([])["per_label"] == {}
+
+
+def _job_map(pid, tok, tid):
+    return {"name": "job_map", "ph": "i", "ts": 0.0, "pid": pid,
+            "tid": "w", "args": {"event_id": tok, "info": tid}}
+
+
+def _job_phase(pid, tid, code, ts):
+    return {"name": "job_phase", "ph": "i", "ts": ts, "pid": pid,
+            "tid": "w", "args": {"event_id": tid, "info": code}}
+
+
+def test_job_phase_run_window_clamped_into_envelope():
+    """Residual cross-rank clock correction can land a remote exec end
+    PAST the submitting rank's done instant (and a begin before
+    submit).  The phase partition must stay self-consistent anyway:
+    run <= total, drain >= 0 — a run never outlives its job."""
+    tid = 0xABC
+    evs = []
+    # begin 2us before submit, end 5us after done: both impossible
+    # instants, both pure skew artifacts
+    evs += _span("exec", 0, -2, 100, tok=1)
+    evs += _span("exec", 1, 150, 405, tok=2)
+    evs += [_edge(0, 1, 2)]
+    evs += [_job_map(0, 1, tid), _job_map(1, 2, tid)]
+    evs += [_job_phase(0, tid, 1, 0.0),    # submit
+            _job_phase(0, tid, 2, 10.0),   # admit
+            _job_phase(0, tid, 3, 400.0)]  # done
+    rep = critpath.analyze(evs, job="abc")
+    ph = rep["phases"]
+    assert ph["total_us"] == pytest.approx(400.0)
+    assert ph["run_us"] <= ph["total_us"]
+    assert ph["run_us"] == pytest.approx(400.0)  # clamped [0, 400]
+    assert ph["drain_us"] == pytest.approx(0.0)  # not negative
+    assert ph["queue_us"] == pytest.approx(10.0)

@@ -1,0 +1,98 @@
+"""Deferred local failure in collectives (comm/coll.py): a failed
+segment pull is a SYMPTOM — the op parks the generic reason for
+``coll_err_grace`` seconds so the origin rank's in-flight "err" notice
+can supply the root cause, and only a silent peer lets the parked
+reason surface.  Pins the deterministic fix for the pre-PR-20
+allgather-fails-loudly flake (a rank racing the origin's notice raised
+"segment pull ... failed" instead of "advert mismatch ...")."""
+
+import collections
+import threading
+import time
+
+from parsec_tpu.comm.coll import _BaseOp
+
+
+class _FakeMgr:
+    def __init__(self, grace):
+        self.err_grace = grace
+        self.stats = collections.Counter()
+        self.unbound = []
+
+    def unbind(self, cid):
+        self.unbound.append(cid)
+
+
+class _FakeCE:
+    rank = 1
+
+    def send_am(self, *a, **k):
+        raise AssertionError("single-rank group never notifies peers")
+
+    def mem_unregister(self, handle):
+        pass
+
+
+def _op(grace=5.0):
+    """A bare _BaseOp wired to fakes — only the failure plumbing under
+    test, no endpoint, no wire."""
+    op = object.__new__(_BaseOp)
+    op.mgr = _FakeMgr(grace)
+    op.ce = _FakeCE()
+    op.cid = ("t", 1)
+    op.kind = "allgather"
+    op.token = 1
+    op.priority = -1
+    op.group = [1]
+    op.trace = 0
+    op._lock = threading.RLock()
+    op._cv = threading.Condition(op._lock)
+    op.done = False
+    op.failed = False
+    op.fail_reason = None
+    op._pending_fail = None
+    op._result = None
+    op._holders = []
+    op._staged = {}
+    op.t0 = time.perf_counter()
+    op.total_bytes = 0
+    return op
+
+
+def test_deferred_failure_waits_out_the_grace_window():
+    op = _op(grace=30.0)
+    op._fail_deferred("segment pull of 'h' from rank 0 failed")
+    assert not op.failed                      # parked, not raised
+    op._check_pending_fail()                  # deadline far away: no-op
+    assert not op.failed and op.fail_reason is None
+
+
+def test_peer_root_cause_wins_over_parked_reason():
+    op = _op(grace=30.0)
+    op._fail_deferred("segment pull of 'h' from rank 0 failed")
+    # the origin's err notice lands (on_msg 'err' -> _fail with why)
+    op._fail("peer rank 0: advert mismatch nbytes 48 != 64",
+             notify_peers=False)
+    assert op.failed and "advert mismatch" in op.fail_reason
+    # the expired parked reason can never overwrite the root cause
+    op._pending_fail = (op._pending_fail[0], time.monotonic() - 1)
+    op._check_pending_fail()
+    assert "advert mismatch" in op.fail_reason
+
+
+def test_silent_peer_expires_to_the_parked_reason():
+    op = _op(grace=0.0)                       # 0 = fail immediately
+    op._fail_deferred("segment pull of 'h' from rank 0 failed")
+    assert not op.failed                      # still parked until polled
+    op._check_pending_fail()                  # wait() polls each lap
+    assert op.failed and "segment pull" in op.fail_reason
+    assert op.mgr.stats["ops_failed"] == 1 and op.mgr.unbound == [op.cid]
+
+
+def test_second_deferral_and_completion_are_inert():
+    op = _op(grace=0.0)
+    op._fail_deferred("first")
+    op._fail_deferred("second")               # first parked reason holds
+    op.done = True                            # op completed meanwhile
+    op._check_pending_fail()
+    assert not op.failed                      # a done op never fails late
